@@ -1,0 +1,120 @@
+"""Headline benchmark: distinct states/sec on the scaled compaction model.
+
+Workload (BASELINE.md north star): ``compaction.tla`` scaled to
+``|KeySpace|=8, MessageSentLimit=64`` with the producer modeled — the deep
+BFS stress configuration.  The state space is astronomically large, so the
+run is time-budgeted: BFS proceeds level by level on the real chip and the
+metric is sustained distinct-states/sec (discovery + dedup + invariant
+checking all included).
+
+Baseline for ``vs_baseline``: the pure-Python reference evaluator
+(`pulsar_tlaplus_tpu/ref/pyeval.py`) on the same workload, time-sliced on
+this host.  The image has no JVM, so 8-worker CPU TLC — the north-star
+baseline (target: >=20x) — cannot be measured here; the Python evaluator
+is the same explicit-state algorithm and is the honest in-image stand-in
+(BASELINE.md notes measuring TLC is an out-of-image task).
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+BENCH_BUDGET_S = 120.0
+BASELINE_SLICE_S = 20.0
+
+
+def scaled_config():
+    from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+    return Constants(
+        message_sent_limit=64,
+        compaction_times_limit=3,
+        num_keys=8,
+        num_values=2,
+        retain_null_key=True,
+        max_crash_times=3,
+        model_producer=True,
+        model_consumer=False,
+    )
+
+
+def measure_python_baseline(c, budget_s: float) -> float:
+    """Timed BFS slice of the reference evaluator; returns states/sec."""
+    from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+    t0 = time.time()
+    seen = set()
+    frontier = []
+    for s in pe.initial_states(c):
+        seen.add(s)
+        frontier.append(s)
+    n_checked = 0
+    invs = [pe.INVARIANTS[n] for n in pe.DEFAULT_INVARIANTS]
+    while frontier and time.time() - t0 < budget_s:
+        new = []
+        for s in frontier:
+            for _a, t in pe.successors(c, s):
+                if t not in seen:
+                    seen.add(t)
+                    new.append(t)
+                    for fn in invs:
+                        fn(c, t)
+                    n_checked += 1
+            if time.time() - t0 > budget_s:
+                break
+        frontier = new
+    return len(seen) / max(time.time() - t0, 1e-9)
+
+
+def main():
+    import jax
+
+    c = scaled_config()
+    dev = jax.devices()[0]
+    print(f"bench device: {dev}", file=sys.stderr)
+
+    from pulsar_tlaplus_tpu.engine.bfs import Checker
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+
+    model = CompactionModel(c)
+    print(
+        f"scaled config: state width {model.layout.total_bits} bits "
+        f"({model.layout.W} words), {model.A} action lanes",
+        file=sys.stderr,
+    )
+    ck = Checker(
+        model,
+        frontier_chunk=8192,
+        visited_cap=1 << 22,
+        time_budget_s=BENCH_BUDGET_S,
+        progress=True,
+    )
+    r = ck.run()
+    print(
+        f"tpu: {r.distinct_states} states in {r.wall_s:.1f}s "
+        f"({r.states_per_sec:.0f} st/s), {r.diameter} levels, "
+        f"truncated={r.truncated}",
+        file=sys.stderr,
+    )
+
+    base_sps = measure_python_baseline(c, BASELINE_SLICE_S)
+    print(f"python-oracle baseline: {base_sps:.0f} st/s", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "distinct states/sec on scaled compaction.tla "
+                "(|Keys|=8, |Msgs|=64, producer modeled; dedup + "
+                "TypeSafe + CompactionHorizonCorrectness fused)",
+                "value": round(r.states_per_sec, 1),
+                "unit": "states/sec/chip",
+                "vs_baseline": round(r.states_per_sec / max(base_sps, 1e-9), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

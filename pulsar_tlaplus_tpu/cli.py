@@ -1,0 +1,144 @@
+"""Command-line interface — the TLC-shaped operator layer (SURVEY.md §1-L4).
+
+Usage mirrors ``java tlc2.TLC``:
+
+    python -m pulsar_tlaplus_tpu.cli check SPEC.tla [-config FILE.cfg]
+        [-workers tpu | N] [-sharded N] [-invariant NAME ...]
+        [-nodeadlock] [-cpu]
+
+``check`` runs exhaustive BFS model checking of the named spec (currently
+the hand-compiled ``compaction`` module; the spec->IR front end is the next
+layer, SURVEY.md §2.2-E1) and prints a TLC-style summary: distinct states,
+diameter, and a counterexample trace on invariant violation or deadlock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _positive_or_tpu(v: str):
+    return v if v == "tpu" else int(v)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="tpu-tlc")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pc = sub.add_parser("check", help="exhaustive BFS model checking")
+    pc.add_argument("spec", help="path to the .tla module (module 'compaction')")
+    pc.add_argument("-config", help=".cfg file (defaults to SPEC's .cfg)")
+    pc.add_argument(
+        "-workers",
+        type=_positive_or_tpu,
+        default="tpu",
+        help="'tpu' (default) or a worker count (ignored; kept for TLC CLI parity)",
+    )
+    pc.add_argument(
+        "-sharded",
+        type=int,
+        default=0,
+        metavar="N",
+        help="run mesh-sharded over N devices",
+    )
+    pc.add_argument(
+        "-invariant",
+        action="append",
+        default=None,
+        help="invariant name to check (repeatable; default: cfg INVARIANTS)",
+    )
+    pc.add_argument(
+        "-nodeadlock",
+        action="store_true",
+        help="disable deadlock checking (TLC: -deadlock)",
+    )
+    pc.add_argument(
+        "-cpu", action="store_true", help="force the CPU backend"
+    )
+    pc.add_argument("-chunk", type=int, default=4096)
+    pc.add_argument("-maxstates", type=int, default=200_000_000)
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+    from pulsar_tlaplus_tpu.utils.render import render_trace
+
+    spec_path = args.spec
+    module = os.path.splitext(os.path.basename(spec_path))[0]
+    if module != "compaction":
+        sys.exit(
+            f"tpu-tlc: unknown module '{module}': the compiled-spec registry "
+            "currently contains: compaction"
+        )
+    cfg_path = args.config or os.path.splitext(spec_path)[0] + ".cfg"
+    if not os.path.exists(cfg_path):
+        sys.exit(f"tpu-tlc: config file not found: {cfg_path}")
+    tlc_cfg = cfgmod.load(cfg_path)
+    constants = cfgmod.to_constants(tlc_cfg)
+    invariants = tuple(args.invariant or tlc_cfg.invariants)
+
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ref import pyeval
+
+    unknown = [i for i in invariants if i not in pyeval.INVARIANTS]
+    if unknown:
+        sys.exit(f"tpu-tlc: unknown invariant(s): {unknown}")
+
+    model = CompactionModel(constants)
+    print(
+        f"tpu-tlc: checking {module} @ {cfg_path} "
+        f"(state width {model.layout.total_bits} bits, "
+        f"{model.A} successor lanes; invariants: {list(invariants) or 'none'})"
+    )
+    t0 = time.time()
+    if args.sharded:
+        from pulsar_tlaplus_tpu.engine.sharded import ShardedChecker
+
+        ck = ShardedChecker(
+            model,
+            n_devices=args.sharded,
+            invariants=invariants,
+            check_deadlock=not args.nodeadlock,
+            frontier_chunk=args.chunk,
+            max_states=args.maxstates,
+        )
+    else:
+        from pulsar_tlaplus_tpu.engine.bfs import Checker
+
+        ck = Checker(
+            model,
+            invariants=invariants,
+            check_deadlock=not args.nodeadlock,
+            frontier_chunk=args.chunk,
+            max_states=args.maxstates,
+            progress=True,
+        )
+    r = ck.run()
+    wall = time.time() - t0
+    if r.violation and r.violation != "Deadlock":
+        print(f"Error: Invariant {r.violation} is violated.")
+        print("The behavior up to this point is:")
+        print(render_trace(r.trace, r.trace_actions, constants))
+    elif r.deadlock:
+        print("Error: Deadlock reached.")
+        print("The behavior up to this point is:")
+        print(render_trace(r.trace, r.trace_actions, constants))
+    print(
+        f"{r.distinct_states} distinct states found, "
+        f"search depth (diameter) {r.diameter}."
+    )
+    print(
+        f"Finished in {wall:.1f}s "
+        f"({r.states_per_sec:.0f} distinct states/sec)."
+    )
+    return 1 if (r.violation or r.deadlock) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

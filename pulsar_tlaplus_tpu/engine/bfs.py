@@ -1,0 +1,313 @@
+"""Single-chip BFS model-checking engine (SURVEY.md §7-L2).
+
+The implied-TLC engine (SURVEY.md §1-L1) re-architected for XLA:
+
+- the frontier is a padded ``uint32[F, W]`` array of packed states;
+- one jitted *expand* step per frontier chunk runs vmapped successor
+  generation (all ``Next`` lanes at once), packs, fingerprints, sorts,
+  binary-searches the visited set, compacts the new states to the front,
+  merges them into the sorted visited set, and evaluates the selected
+  invariants on exactly the new states — all on device;
+- the host driver only orchestrates chunks/levels, tracks global state ids
+  and the ``(parent, action)`` log for counterexample reconstruction
+  (SURVEY.md §2.2-E7), and makes the termination decision (one scalar sync
+  per chunk, mirroring the per-level host boundary in SURVEY.md §3.3).
+
+Within-level cross-chunk duplicates need no extra pass: each chunk's new
+states are merged into the visited set before the next chunk's lookup, and
+every state discovered in level N is at BFS depth N regardless of which
+chunk emitted it, so shortest-counterexample semantics are preserved.
+
+Deadlock checking follows TLC's default-on behavior: a state deadlocks iff
+no ``Next`` disjunct — including the stuttering Consumer/Terminating lanes
+(compaction.tla:185-186, 205-214) — is enabled.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.ref import pyeval
+
+
+@dataclass
+class CheckerResult:
+    distinct_states: int
+    diameter: int  # BFS levels; initial states = level 1 (matches oracle)
+    violation: Optional[str] = None
+    trace: Optional[list] = None  # list[pyeval.State]
+    trace_actions: Optional[list] = None  # action names along the trace
+    deadlock: bool = False
+    states_per_sec: float = 0.0
+    wall_s: float = 0.0
+    level_sizes: List[int] = field(default_factory=list)
+    truncated: bool = False  # stopped by time/state budget, not exhaustion
+
+
+class Checker:
+    """BFS checker for a compiled spec model on a single device."""
+
+    def __init__(
+        self,
+        model: CompactionModel,
+        invariants: Tuple[str, ...] = pyeval.DEFAULT_INVARIANTS,
+        check_deadlock: bool = True,
+        frontier_chunk: int = 4096,
+        visited_cap: int = 1 << 13,
+        max_states: int = 200_000_000,
+        time_budget_s: Optional[float] = None,
+        progress: bool = False,
+    ):
+        self.model = model
+        self.layout = model.layout
+        self.invariant_names = tuple(invariants)
+        self.check_deadlock = check_deadlock
+        self.F = frontier_chunk
+        self.max_states = max_states
+        self.time_budget_s = time_budget_s
+        self.progress = progress
+        self._cap = visited_cap
+        self._jit_cache: Dict[Tuple[str, int], object] = {}
+        self._unpack1 = jax.jit(self.layout.unpack)
+
+    # ------------------------------------------------------------------
+    # jitted steps (cached per visited capacity tier)
+    # ------------------------------------------------------------------
+
+    def _dedup_core(self, packed, valid, parent, action, vk1, vk2, vk3, n_visited):
+        return dedup_core(
+            self.model,
+            self.invariant_names,
+            packed,
+            valid,
+            parent,
+            action,
+            vk1,
+            vk2,
+            vk3,
+            n_visited,
+        )
+
+    def _get_step(self, kind: str):
+        key = (kind, self._cap)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        m = self.model
+
+        if kind == "insert":
+
+            def step(packed, valid, vk1, vk2, vk3, n_visited):
+                n = packed.shape[0]
+                parent = jnp.full((n,), -1, jnp.int32)
+                action = jnp.full((n,), -1, jnp.int32)
+                return self._dedup_core(
+                    packed, valid, parent, action, vk1, vk2, vk3, n_visited
+                )
+
+        else:
+
+            def step(frontier, n, vk1, vk2, vk3, n_visited):
+                f = frontier.shape[0]
+                row_live = jnp.arange(f, dtype=jnp.int32) < n
+                states = jax.vmap(self.layout.unpack)(frontier)
+                succ, valid = jax.vmap(m.successors)(states)  # [F, A]
+                valid = valid & row_live[:, None]
+                packed = jax.vmap(jax.vmap(self.layout.pack))(succ)
+                fa = f * m.A
+                packed = packed.reshape(fa, self.layout.W)
+                parent = jnp.repeat(jnp.arange(f, dtype=jnp.int32), m.A)
+                action = jnp.tile(jnp.asarray(m.action_ids), f)
+                core = self._dedup_core(
+                    packed,
+                    valid.reshape(fa),
+                    parent,
+                    action,
+                    vk1,
+                    vk2,
+                    vk3,
+                    n_visited,
+                )
+                if self.check_deadlock:
+                    stutter = jax.vmap(m.stutter_enabled)(states)
+                    dead = row_live & ~jnp.any(valid, axis=1) & ~stutter
+                    dead_idx = jnp.min(
+                        jnp.where(dead, jnp.arange(f, dtype=jnp.int32), f)
+                    )
+                else:
+                    dead_idx = jnp.int32(f)
+                return core + (dead_idx,)
+
+        fn = jax.jit(step)
+        self._jit_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # host driver
+    # ------------------------------------------------------------------
+
+    def _grow_visited(self, vk, need: int):
+        cap = self._cap
+        while cap < need:
+            cap *= 4
+        if cap != self._cap:
+            pad = cap - self._cap
+            vk = tuple(
+                jnp.concatenate([col, jnp.full((pad,), SENTINEL, jnp.uint32)])
+                for col in vk
+            )
+            self._cap = cap
+        return vk
+
+    def run(self) -> CheckerResult:
+        m = self.model
+        t0 = time.time()
+        vk = tuple(jnp.full((self._cap,), SENTINEL, jnp.uint32) for _ in range(3))
+        n_visited = 0
+        # Host-side (parent, action, packed) log for trace reconstruction.
+        all_packed: List[np.ndarray] = []
+        all_parent: List[np.ndarray] = []
+        all_action: List[np.ndarray] = []
+        n_total = 0
+        level_sizes: List[int] = []
+
+        def flush_chunk(out, frontier_gids, base_row) -> Tuple[int, Optional[Tuple[str, int]]]:
+            """Copy a step's new states to the host log; returns (n_new, violation)."""
+            nonlocal n_total
+            (packed, parent, action, n_new, nk1, nk2, nk3, viol) = out[:8]
+            n_new = int(n_new)
+            if n_new:
+                np_packed = np.asarray(packed[:n_new])
+                np_parent = np.asarray(parent[:n_new])
+                np_action = np.asarray(action[:n_new])
+                if frontier_gids is None:
+                    gids = np.full((n_new,), -1, np.int64)
+                else:
+                    gids = frontier_gids[base_row + np_parent]
+                all_packed.append(np_packed)
+                all_parent.append(gids)
+                all_action.append(np_action)
+            violation = None
+            viol = np.asarray(viol)
+            for i, name in enumerate(self.invariant_names):
+                if int(viol[i]) < n_new:
+                    violation = (name, n_total + int(viol[i]))
+                    break
+            n_total += n_new
+            return n_new, violation
+
+        def build_result(violation, deadlock_gid=None, deadlock=False, truncated=False):
+            wall = time.time() - t0
+            res = CheckerResult(
+                distinct_states=n_total,
+                diameter=len(level_sizes),
+                deadlock=deadlock,
+                wall_s=wall,
+                states_per_sec=n_total / max(wall, 1e-9),
+                level_sizes=level_sizes,
+                truncated=truncated,
+            )
+            gid = None
+            if violation is not None:
+                res.violation = violation[0]
+                gid = violation[1]
+            elif deadlock:
+                res.violation = "Deadlock"
+                gid = deadlock_gid
+            if gid is not None:
+                res.trace, res.trace_actions = build_trace(
+                    self.model, self._unpack1, gid, all_packed, all_parent, all_action
+                )
+            return res
+
+        # ---- level 1: initial states (compaction.tla:188-202) ----
+        n_init = m.n_initial
+        gen = jax.jit(
+            jax.vmap(lambda i: self.layout.pack(m.gen_initial(i)))
+        )
+        insert_new = 0
+        for start in range(0, n_init, self.F):
+            idx = jnp.arange(start, start + self.F, dtype=jnp.int32)
+            packed = gen(idx)
+            valid = np.arange(start, start + self.F) < n_init
+            vk = self._grow_visited(vk, n_visited + self.F + 1)
+            out = self._get_step("insert")(
+                packed, jnp.asarray(valid), *vk, jnp.int32(n_visited)
+            )
+            vk = out[4:7]
+            n_new, violation = flush_chunk(out, None, 0)
+            insert_new += n_new
+            n_visited += n_new
+            if violation is not None:
+                level_sizes.append(insert_new)
+                return build_result(violation)
+        level_sizes.append(insert_new)
+        frontier = (
+            np.concatenate(all_packed) if all_packed else np.zeros((0, self.layout.W), np.uint32)
+        )
+        frontier_gids = np.arange(n_total, dtype=np.int64)
+
+        # ---- BFS levels ----
+        while len(frontier):
+            level_new_packed: List[np.ndarray] = []
+            level_base = n_total
+            for start in range(0, len(frontier), self.F):
+                chunk = frontier[start : start + self.F]
+                nc = len(chunk)
+                if nc < self.F:
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((self.F - nc, self.layout.W), np.uint32)]
+                    )
+                vk = self._grow_visited(vk, n_visited + self.F * m.A + 1)
+                out = self._get_step("expand")(
+                    jnp.asarray(chunk), jnp.int32(nc), *vk, jnp.int32(n_visited)
+                )
+                vk = out[4:7]
+                dead_idx = int(out[8])
+                n_new, violation = flush_chunk(out, frontier_gids, start)
+                n_visited += n_new
+                if n_new:
+                    level_new_packed.append(all_packed[-1])
+                if violation is not None:
+                    level_sizes.append(n_total - level_base)
+                    return build_result(violation)
+                if dead_idx < nc:
+                    level_sizes.append(n_total - level_base)
+                    return build_result(
+                        None,
+                        deadlock_gid=int(frontier_gids[start + dead_idx]),
+                        deadlock=True,
+                    )
+                if n_visited > self.max_states or (
+                    self.time_budget_s is not None
+                    and time.time() - t0 > self.time_budget_s
+                ):
+                    level_sizes.append(n_total - level_base)
+                    return build_result(None, truncated=True)
+            level_count = n_total - level_base
+            if level_count == 0:
+                break
+            level_sizes.append(level_count)
+            if self.progress:
+                import sys
+
+                wall = time.time() - t0
+                print(
+                    f"  level {len(level_sizes)}: +{level_count} "
+                    f"(total {n_total}, {n_total/max(wall,1e-9):.0f} st/s)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            frontier = np.concatenate(level_new_packed)
+            frontier_gids = np.arange(level_base, n_total, dtype=np.int64)
+
+        return build_result(None)

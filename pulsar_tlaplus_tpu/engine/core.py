@@ -1,0 +1,110 @@
+"""Device-side step primitives shared by the single-chip and mesh-sharded
+checkers: lane partitioning, dedup/merge against the sorted visited set, and
+fused invariant evaluation on newly discovered states (SURVEY.md §2.2-E3/E5)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+import numpy as np
+
+from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.ref import pyeval
+
+
+def partition_perm(keep: jax.Array) -> jax.Array:
+    """Stable permutation moving keep-lanes to the front."""
+    n = keep.shape[0]
+    iota = jnp.arange(n, dtype=jnp.uint32)
+    _, perm = jax.lax.sort(
+        ((~keep).astype(jnp.uint32), iota), num_keys=1, is_stable=True
+    )
+    return perm.astype(jnp.int32)
+
+
+def dedup_core(
+    model,
+    invariant_names: Tuple[str, ...],
+    packed: jax.Array,
+    valid: jax.Array,
+    parent: jax.Array,
+    action: jax.Array,
+    vk1: jax.Array,
+    vk2: jax.Array,
+    vk3: jax.Array,
+    n_visited: jax.Array,
+):
+    """Dedup candidate lanes against the sorted visited set and merge.
+
+    Returns (out_packed, out_parent, out_action, n_new, vk1', vk2', vk3',
+    viol) where the first ``n_new`` output lanes are the newly discovered
+    states (sorted by key — deterministic), the visited columns are updated,
+    and ``viol[i]`` is the first output lane violating invariant i (or the
+    lane count if none).
+    """
+    layout = model.layout
+    n = packed.shape[0]
+    k1, k2, k3 = dedup.make_keys(packed, layout.total_bits)
+    perm = dedup.sort_perm(~valid, k1, k2, k3)
+    sp = packed[perm]
+    sv = valid[perm]
+    sk1, sk2, sk3 = k1[perm], k2[perm], k3[perm]
+    spar, sact = parent[perm], action[perm]
+    same_prev = jnp.zeros((n,), jnp.bool_)
+    same_prev = same_prev.at[1:].set(
+        (sk1[1:] == sk1[:-1]) & (sk2[1:] == sk2[:-1]) & (sk3[1:] == sk3[:-1])
+    )
+    member = dedup.bsearch_member(vk1, vk2, vk3, n_visited, sk1, sk2, sk3)
+    is_new = sv & ~same_prev & ~member
+    n_new = jnp.sum(is_new.astype(jnp.int32))
+    perm2 = partition_perm(is_new)
+    out_packed = sp[perm2]
+    out_parent = spar[perm2]
+    out_action = sact[perm2]
+    ok1, ok2, ok3 = sk1[perm2], sk2[perm2], sk3[perm2]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    live = lane < n_new
+    nvk1, nvk2, nvk3 = dedup.merge_sorted(
+        vk1, vk2, vk3,
+        jnp.where(live, ok1, SENTINEL),
+        jnp.where(live, ok2, SENTINEL),
+        jnp.where(live, ok3, SENTINEL),
+    )
+    # Invariants fused over exactly the new states (SURVEY.md §3.4).
+    states = jax.vmap(layout.unpack)(out_packed)
+    viol_idx = []
+    for name in invariant_names:
+        ok = jax.vmap(model.invariants[name])(states)
+        viol_idx.append(jnp.min(jnp.where(live & ~ok, lane, n)))
+    viol = (
+        jnp.stack(viol_idx) if viol_idx else jnp.zeros((0,), jnp.int32)
+    )
+    return out_packed, out_parent, out_action, n_new, nvk1, nvk2, nvk3, viol
+
+
+def build_trace(model, unpack1, gid: int, all_packed, all_parent, all_action):
+    """Reconstruct the counterexample behavior ending at global state ``gid``
+    from the host-side (packed, parent, action) log (SURVEY.md §2.2-E7).
+
+    Returns (states as pyeval.State list, action names along the trace).
+    """
+    packed = np.concatenate(all_packed)
+    parent = np.concatenate(all_parent)
+    action = np.concatenate(all_action)
+    chain = []
+    g = gid
+    while g >= 0:
+        chain.append(g)
+        g = int(parent[g])
+    chain.reverse()
+    states, actions = [], []
+    for i, g in enumerate(chain):
+        s = unpack1(jnp.asarray(packed[g]))
+        states.append(model.to_pystate(s))
+        if i > 0:
+            actions.append(pyeval.ACTION_NAMES[int(action[g])])
+    return states, actions

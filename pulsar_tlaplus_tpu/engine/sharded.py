@@ -1,0 +1,385 @@
+"""Mesh-sharded BFS checker (SURVEY.md §7-L3, §2.2-E3/E6/E11).
+
+TLC's worker threads + shared FPSet become, TPU-natively:
+
+- **frontier data-parallelism**: each device expands its own frontier shard
+  with the same vmapped successor/invariant kernels (the DP analog);
+- **fingerprint-space sharding**: the visited set is partitioned by
+  ``key % n_shards``; every candidate successor is routed to its owning
+  device with one ``all_to_all`` over the mesh axis (ICI within a slice,
+  DCN across slices), then deduped locally with the exact same
+  ``dedup_core`` as the single-chip engine (the TP analog);
+- newly discovered states *stay on their owner* and form that device's
+  next-level frontier shard — hash ownership doubles as load balancing, so
+  no rebalancing pass is needed.
+
+Determinism: for any device count, the reachable state set, counts, levels,
+and invariant verdicts are identical (tested over a virtual CPU mesh with
+n in {1, 2, 4, 8}); only which shortest counterexample gets reported may
+vary, as with TLC's ``-workers N``.
+
+Routing buffers are provably overflow-free: each sender contributes at most
+its own lane count to any one destination, so per-destination capacity =
+the sender's lane count suffices.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
+from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.parallel.mesh import AXIS, make_mesh
+from pulsar_tlaplus_tpu.ref import pyeval
+
+
+class ShardedChecker:
+    """BFS checker sharded over a 1-D device mesh."""
+
+    def __init__(
+        self,
+        model: CompactionModel,
+        n_devices: int | None = None,
+        invariants: Tuple[str, ...] = pyeval.DEFAULT_INVARIANTS,
+        check_deadlock: bool = True,
+        frontier_chunk: int = 1024,
+        visited_cap: int = 1 << 13,
+        max_states: int = 1_000_000_000,
+        mesh=None,
+    ):
+        self.model = model
+        self.layout = model.layout
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.n_shards = self.mesh.devices.size
+        self.invariant_names = tuple(invariants)
+        self.check_deadlock = check_deadlock
+        self.F = frontier_chunk
+        if max_states >= 2**31:
+            # gids travel to the device as int32 (routed with each candidate
+            # lane); >2^31 states needs a two-word gid encoding (future work)
+            raise ValueError("sharded checker supports max_states < 2**31")
+        self.max_states = max_states
+        self._cap = visited_cap
+        self._jit_cache: Dict[Tuple[str, int], object] = {}
+        self._unpack1 = jax.jit(self.layout.unpack)
+
+    # ------------------------------------------------------------------
+    # device code
+    # ------------------------------------------------------------------
+
+    def _route(self, packed, valid, parent, action):
+        """Route candidate lanes to their key-owner shard via all_to_all.
+
+        packed u32[L, W] (plus parallel valid/parent/action lanes) ->
+        the lanes this shard owns: u32[n_shards*L, W] etc.
+        """
+        nd = self.n_shards
+        L, W = packed.shape
+        k1, _, _ = dedup.make_keys(packed, self.layout.total_bits)
+        owner = jnp.where(valid, (k1 % nd).astype(jnp.int32), nd)
+        iota = jnp.arange(L, dtype=jnp.uint32)
+        sowner, perm_u = jax.lax.sort(
+            (owner.astype(jnp.uint32), iota), num_keys=1, is_stable=True
+        )
+        perm = perm_u.astype(jnp.int32)
+        sp, sv = packed[perm], valid[perm]
+        spar, sact = parent[perm], action[perm]
+        # start offset of each destination bucket in the sorted order
+        starts = jnp.searchsorted(
+            sowner, jnp.arange(nd + 1, dtype=jnp.uint32)
+        ).astype(jnp.int32)
+        pos_in_bucket = jnp.arange(L, dtype=jnp.int32) - starts[
+            jnp.clip(sowner.astype(jnp.int32), 0, nd)
+        ]
+        # scatter into [nd, L] send buffers; invalid lanes indexed out of
+        # range and dropped
+        flat_idx = jnp.where(
+            sv, sowner.astype(jnp.int32) * L + pos_in_bucket, nd * L
+        )
+        send_packed = jnp.zeros((nd * L, W), jnp.uint32).at[flat_idx].set(
+            sp, mode="drop"
+        )
+        send_valid = jnp.zeros((nd * L,), jnp.bool_).at[flat_idx].set(
+            sv, mode="drop"
+        )
+        send_parent = jnp.zeros((nd * L,), jnp.int32).at[flat_idx].set(
+            spar, mode="drop"
+        )
+        send_action = jnp.zeros((nd * L,), jnp.int32).at[flat_idx].set(
+            sact, mode="drop"
+        )
+        a2a = lambda x: jax.lax.all_to_all(
+            x.reshape((nd, L) + x.shape[1:]), AXIS, 0, 0
+        ).reshape((nd * L,) + x.shape[1:])
+        return (
+            a2a(send_packed),
+            a2a(send_valid),
+            a2a(send_parent),
+            a2a(send_action),
+        )
+
+    def _get_step(self, kind: str):
+        key = (kind, self._cap)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        m = self.model
+        nd = self.n_shards
+
+        def insert_body(packed, valid, gids, vk1, vk2, vk3, n_visited):
+            parent = jnp.full(valid.shape, -1, jnp.int32)
+            action = jnp.full(valid.shape, -1, jnp.int32)
+            rp, rv, rpar, ract = self._route(packed, valid, parent, action)
+            core = dedup_core(
+                m, self.invariant_names, rp, rv, rpar, ract,
+                vk1, vk2, vk3, n_visited,
+            )
+            return core + (jnp.int32(0),)
+
+        def expand_body(frontier, n, gids, vk1, vk2, vk3, n_visited):
+            f = frontier.shape[0]
+            row_live = jnp.arange(f, dtype=jnp.int32) < n
+            states = jax.vmap(self.layout.unpack)(frontier)
+            succ, valid = jax.vmap(m.successors)(states)
+            valid = valid & row_live[:, None]
+            packed = jax.vmap(jax.vmap(self.layout.pack))(succ).reshape(
+                f * m.A, self.layout.W
+            )
+            parent_gid = jnp.repeat(gids, m.A)
+            action = jnp.tile(jnp.asarray(m.action_ids), f)
+            rp, rv, rpar, ract = self._route(
+                packed, valid.reshape(f * m.A), parent_gid, action
+            )
+            core = dedup_core(
+                m, self.invariant_names, rp, rv, rpar, ract,
+                vk1, vk2, vk3, n_visited,
+            )
+            if self.check_deadlock:
+                stutter = jax.vmap(m.stutter_enabled)(states)
+                dead = row_live & ~jnp.any(valid, axis=1) & ~stutter
+                dead_idx = jnp.min(
+                    jnp.where(dead, jnp.arange(f, dtype=jnp.int32), f)
+                )
+            else:
+                dead_idx = jnp.int32(f)
+            return core + (dead_idx,)
+
+        body = insert_body if kind == "insert" else expand_body
+
+        def shard_fn(stacked_args):
+            args = [
+                x[0] if isinstance(x, jax.Array) or hasattr(x, "shape") else x
+                for x in stacked_args
+            ]
+            out = body(*args)
+            return tuple(o[None] for o in out)
+
+        in_spec = (P(AXIS),)
+        out_spec = P(AXIS)
+        mapped = jax.shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=in_spec,
+            out_specs=out_spec,
+            check_vma=False,
+        )
+        fn = jax.jit(mapped)
+        self._jit_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # host driver
+    # ------------------------------------------------------------------
+
+    def _grow_visited(self, vk, need_per_shard: int):
+        cap = self._cap
+        while cap < need_per_shard:
+            cap *= 4
+        if cap != self._cap:
+            pad = cap - self._cap
+            vk = tuple(
+                jnp.concatenate(
+                    [col, jnp.full((col.shape[0], pad), SENTINEL, jnp.uint32)],
+                    axis=1,
+                )
+                for col in vk
+            )
+            self._cap = cap
+        return vk
+
+    def run(self) -> CheckerResult:
+        m = self.model
+        nd = self.n_shards
+        t0 = time.time()
+        vk = tuple(
+            jnp.full((nd, self._cap), SENTINEL, jnp.uint32) for _ in range(3)
+        )
+        n_visited = np.zeros((nd,), np.int64)
+        all_packed: List[np.ndarray] = []
+        all_parent: List[np.ndarray] = []
+        all_action: List[np.ndarray] = []
+        n_total = 0
+        level_sizes: List[int] = []
+        # per-shard next-level frontier accumulators (host)
+        next_parts: List[List[np.ndarray]] = [[] for _ in range(nd)]
+        next_gid_parts: List[List[np.ndarray]] = [[] for _ in range(nd)]
+
+        def flush(out) -> Tuple[int, Optional[Tuple[str, int]]]:
+            """Harvest all shards' new states into the log and the
+            next-level accumulators; returns (n_new_total, violation)."""
+            nonlocal n_total
+            packed, parent, action, n_new = out[0], out[1], out[2], out[3]
+            viol = np.asarray(out[7])
+            n_new = np.asarray(n_new)
+            violation = None
+            total_new = 0
+            for d in range(nd):
+                nn = int(n_new[d])
+                n_visited[d] += nn
+                if nn == 0:
+                    continue
+                np_packed = np.asarray(packed[d][:nn])
+                all_packed.append(np_packed)
+                all_parent.append(np.asarray(parent[d][:nn]).astype(np.int64))
+                all_action.append(np.asarray(action[d][:nn]))
+                next_parts[d].append(np_packed)
+                next_gid_parts[d].append(
+                    np.arange(n_total, n_total + nn, dtype=np.int64)
+                )
+                for i, name in enumerate(self.invariant_names):
+                    vi = int(viol[d][i])
+                    if vi < nn and violation is None:
+                        violation = (name, n_total + vi)
+                n_total += nn
+                total_new += nn
+            return total_new, violation
+
+        def take_next():
+            """Drain accumulators -> per-shard frontier arrays."""
+            fr, gd = [], []
+            for d in range(nd):
+                fr.append(
+                    np.concatenate(next_parts[d])
+                    if next_parts[d]
+                    else np.zeros((0, self.layout.W), np.uint32)
+                )
+                gd.append(
+                    np.concatenate(next_gid_parts[d])
+                    if next_gid_parts[d]
+                    else np.zeros((0,), np.int64)
+                )
+                next_parts[d] = []
+                next_gid_parts[d] = []
+            return fr, gd
+
+        def build_result(violation, deadlock_gid=None):
+            wall = time.time() - t0
+            res = CheckerResult(
+                distinct_states=n_total,
+                diameter=len(level_sizes),
+                deadlock=deadlock_gid is not None,
+                wall_s=wall,
+                states_per_sec=n_total / max(wall, 1e-9),
+                level_sizes=level_sizes,
+            )
+            gid = None
+            if violation is not None:
+                res.violation = violation[0]
+                gid = violation[1]
+            elif deadlock_gid is not None:
+                res.violation = "Deadlock"
+                gid = deadlock_gid
+            if gid is not None:
+                res.trace, res.trace_actions = build_trace(
+                    m, self._unpack1, gid, all_packed, all_parent, all_action
+                )
+            return res
+
+        # ---- level 1: initial states, routed to owners ----
+        n_init = m.n_initial
+        gen = jax.jit(jax.vmap(lambda i: self.layout.pack(m.gen_initial(i))))
+        per_round = nd * self.F
+        dummy_gids = jnp.zeros((nd, self.F), jnp.int32)
+        for start in range(0, n_init, per_round):
+            idx = np.arange(start, start + per_round, dtype=np.int64)
+            packed = np.asarray(gen(jnp.asarray(idx % max(n_init, 1), jnp.int32)))
+            valid = idx < n_init
+            vk = self._grow_visited(
+                vk, int(n_visited.max()) + nd * self.F + 1
+            )
+            out = self._get_step("insert")(
+                (
+                    jnp.asarray(packed.reshape(nd, self.F, self.layout.W)),
+                    jnp.asarray(valid.reshape(nd, self.F)),
+                    dummy_gids,
+                    *vk,
+                    jnp.asarray(n_visited, jnp.int32),
+                )
+            )
+            vk = out[4:7]
+            _nn, violation = flush(out)
+            if violation is not None:
+                level_sizes.append(n_total)
+                return build_result(violation)
+        level_sizes.append(n_total)
+        frontier, fgids = take_next()
+
+        # ---- BFS levels ----
+        while any(len(f) for f in frontier):
+            rounds = max((len(f) + self.F - 1) // self.F for f in frontier)
+            level_base = n_total
+            for r in range(rounds):
+                chunk = np.zeros((nd, self.F, self.layout.W), np.uint32)
+                ns = np.zeros((nd,), np.int32)
+                gid_chunk = np.zeros((nd, self.F), np.int64)
+                for d in range(nd):
+                    part = frontier[d][r * self.F : (r + 1) * self.F]
+                    ns[d] = len(part)
+                    chunk[d, : len(part)] = part
+                    gid_chunk[d, : len(part)] = fgids[d][
+                        r * self.F : (r + 1) * self.F
+                    ]
+                vk = self._grow_visited(
+                    vk, int(n_visited.max()) + nd * self.F * m.A + 1
+                )
+                out = self._get_step("expand")(
+                    (
+                        jnp.asarray(chunk),
+                        jnp.asarray(ns),
+                        jnp.asarray(gid_chunk, jnp.int32),
+                        *vk,
+                        jnp.asarray(n_visited, jnp.int32),
+                    )
+                )
+                vk = out[4:7]
+                dead = np.asarray(out[8])
+                _nn, violation = flush(out)
+                if violation is not None:
+                    level_sizes.append(n_total - level_base)
+                    return build_result(violation)
+                for d in range(nd):
+                    if int(dead[d]) < int(ns[d]):
+                        level_sizes.append(n_total - level_base)
+                        return build_result(
+                            None,
+                            deadlock_gid=int(gid_chunk[d][int(dead[d])]),
+                        )
+                if n_total > self.max_states:
+                    raise RuntimeError(
+                        f"state explosion: >{self.max_states} states"
+                    )
+            if n_total == level_base:
+                break
+            level_sizes.append(n_total - level_base)
+            frontier, fgids = take_next()
+
+        return build_result(None)

@@ -1,0 +1,158 @@
+"""Simulation mode (SURVEY.md §2.2-E9): TLC's ``-simulate`` re-architected
+as a batch of vmapped random walkers with per-lane PRNG keys.
+
+Each walker starts from a uniformly drawn initial state and takes ``depth``
+random steps; at each step one enabled ``Next`` lane is chosen uniformly
+(stuttering lanes — Consumer/Terminating — keep the state, matching TLC's
+behavior-space semantics).  Invariants are evaluated on every visited
+state.  No dedup table is needed, so throughput scales with walker count.
+
+The whole rollout is one ``lax.scan`` under ``jit``; the action log is
+returned so a violating behavior can be replayed exactly on the host."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ref import pyeval
+
+
+@dataclass
+class SimulationResult:
+    n_walkers: int
+    depth: int
+    states_visited: int  # walkers x steps (not distinct)
+    violation: Optional[str] = None
+    trace: Optional[list] = None
+    trace_actions: Optional[List[str]] = None
+
+
+class Simulator:
+    def __init__(
+        self,
+        model: CompactionModel,
+        invariants: Tuple[str, ...] = pyeval.DEFAULT_INVARIANTS,
+        n_walkers: int = 4096,
+        depth: int = 64,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.invariant_names = tuple(invariants)
+        self.B = n_walkers
+        self.T = depth
+        self.seed = seed
+
+    def _rollout(self, key):
+        m = self.model
+        inv_fns = [m.invariants[n] for n in self.invariant_names]
+
+        def init_one(k):
+            if m.c.model_producer:
+                return m.gen_initial(jnp.int32(0))
+            # Sample each position's (key, value) digit directly — uniform
+            # over the Init fanout without materializing n_initial (which
+            # overflows any machine int for large MessageSentLimit).
+            digits = jax.random.randint(
+                k, (m.M,), 0, m.kv, jnp.int32
+            )
+            base = m.gen_initial(jnp.int32(0))
+            return base._replace(
+                keys=digits // (m.c.num_values + 1),
+                vals=digits % (m.c.num_values + 1),
+            )
+
+        def step_one(state, k):
+            succ, valid = m.successors(state)
+            stutter = m.stutter_enabled(state)
+            # uniform over enabled lanes; one extra lane = stutter (stay)
+            weights = jnp.concatenate(
+                [valid.astype(jnp.float32), stutter.astype(jnp.float32)[None]]
+            )
+            total = jnp.sum(weights)
+            # no enabled lane at all -> stay put (the exhaustive checker is
+            # what reports deadlocks; simulation just stops progressing)
+            fallback = jnp.zeros((m.A + 1,)).at[m.A].set(1.0)
+            probs = jnp.where(total > 0, weights / jnp.maximum(total, 1.0), fallback)
+            lane = jax.random.choice(k, m.A + 1, p=probs)
+            is_stutter = lane >= m.A
+            lane_c = jnp.minimum(lane, m.A - 1)
+            nxt = jax.tree.map(
+                lambda cur, s: jnp.where(is_stutter, cur, s[lane_c]),
+                state,
+                succ,
+            )
+            ok = jnp.stack([f(nxt) for f in inv_fns]) if inv_fns else jnp.ones((0,), bool)
+            return nxt, (jnp.where(is_stutter, -1, lane_c).astype(jnp.int32), ok)
+
+        def walker(k):
+            k0, krest = jax.random.split(k)
+            s0 = init_one(k0)
+            ok0 = (
+                jnp.stack([f(s0) for f in inv_fns]) if inv_fns else jnp.ones((0,), bool)
+            )
+            ks = jax.random.split(krest, self.T)
+            _, (lanes, oks) = jax.lax.scan(step_one, s0, ks)
+            return s0, ok0, lanes, oks
+
+        keys = jax.random.split(key, self.B)
+        return jax.vmap(walker)(keys)
+
+    def run(self) -> SimulationResult:
+        m = self.model
+        key = jax.random.PRNGKey(self.seed)
+        s0, ok0, lanes, oks = jax.jit(self._rollout)(key)
+        oks = np.asarray(oks)  # [B, T, n_inv]
+        ok0 = np.asarray(ok0)  # [B, n_inv]
+        res = SimulationResult(
+            n_walkers=self.B,
+            depth=self.T,
+            states_visited=self.B * (self.T + 1),
+        )
+        bad0 = np.argwhere(~ok0)
+        badt = np.argwhere(~oks)
+        first = None  # (walker, step index: 0 = initial state, inv)
+        if len(bad0):
+            b, i = bad0[0]
+            first = (int(b), 0, int(i))
+        if len(badt):
+            b, t, i = badt[np.lexsort((badt[:, 0], badt[:, 1]))][0]
+            if first is None or int(t) + 1 < first[1]:
+                first = (int(b), int(t) + 1, int(i))
+        if first is None:
+            return res
+        b, t_viol, inv_i = first
+        res.violation = self.invariant_names[inv_i]
+        # replay walker b on the host through the oracle semantics
+        state = m.to_pystate(jax.tree.map(lambda x: np.asarray(x)[b], s0))
+        trace = [state]
+        actions: List[str] = []
+        lane_log = np.asarray(lanes)[b]
+        for step in range(t_viol):
+            lane = int(lane_log[step])
+            if lane < 0:
+                continue  # stutter: state unchanged, not part of the trace
+            aid = int(m.action_ids[lane])
+            succ = dict(pyeval.successors(m.c, state))
+            # Producer lanes share action id 0; disambiguate by lane k/v
+            if aid == 0:
+                kv = lane  # producer lanes come first, in (key, value) order
+                key_v = kv // (m.c.num_values + 1)
+                val_v = kv % (m.c.num_values + 1)
+                nxt = state._replace(
+                    messages=state.messages
+                    + ((len(state.messages) + 1, key_v, val_v),)
+                )
+            else:
+                nxt = succ[aid]
+            trace.append(nxt)
+            actions.append(pyeval.ACTION_NAMES[aid])
+            state = nxt
+        res.trace = trace
+        res.trace_actions = actions
+        return res

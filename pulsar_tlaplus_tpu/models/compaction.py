@@ -1,0 +1,490 @@
+"""TPU-native compiled model of the ``compaction`` spec.
+
+This module is the hand-compiled equivalent of what the spec front end
+(SURVEY.md §2.2-E1) will eventually generate from ``compaction.tla``: one
+vectorizable kernel per action (compaction.tla:216-231), invariant kernels
+(compaction.tla:236-294), and initial-state generation (compaction.tla:188-202),
+all over the compressed ``SState`` encoding of :mod:`..ops.packing`.
+
+Action lanes: successor generation returns a *static* branch axis ``A`` of
+``(valid, state')`` lanes — the Producer's ``\\E inputKey, inputValue``
+nondeterminism (compaction.tla:85) becomes ``|KeySet|*|ValueSet|`` enumerated
+lanes; the six compactor phases and BrokerCrash are one lane each.  The two
+stuttering disjuncts (Consumer, compaction.tla:185-186; Terminating,
+compaction.tla:205-214) produce no new states and are exposed only as
+enabledness flags for deadlock checking, exactly as TLC treats self-loops.
+
+All kernels are pure functions of a single ``SState``; batch via ``jax.vmap``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pulsar_tlaplus_tpu.ops.packing import Layout, SState
+from pulsar_tlaplus_tpu.ref import pyeval
+from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+
+class CompactionModel:
+    """Compiled ``compaction`` spec for a fixed ``Constants`` binding."""
+
+    def __init__(self, c: Constants):
+        c.validate()
+        self.c = c
+        self.layout = Layout(c)
+        self.M = c.message_sent_limit
+        self.C = c.compaction_times_limit
+        self.MW = self.layout.MW
+        # Producer branch fanout: |KeySet| * |ValueSet| (compaction.tla:85).
+        self.kv = (c.num_keys + 1) * (c.num_values + 1)
+        self.n_producer_lanes = self.kv if c.model_producer else 0
+        # Lane -> pyeval action id (pyeval.ACTION_NAMES order).
+        self.action_ids = np.array(
+            [0] * self.n_producer_lanes + [1, 2, 3, 4, 5, 6, 7], dtype=np.int32
+        )
+        self.A = len(self.action_ids)
+        self._pos = jnp.arange(1, self.M + 1, dtype=jnp.int32)  # [M], 1-based
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _max_led_id(self, led_present: jax.Array) -> jax.Array:
+        """MaxCompactedLedgerId (compaction.tla:103-106); 0 if all Nil."""
+        if self.C == 0:
+            return jnp.int32(0)
+        ids = jnp.arange(1, self.C + 1, dtype=jnp.int32)
+        return jnp.max(ids * led_present)
+
+    def _mask_bits(self, mask_words: jax.Array) -> jax.Array:
+        """u32[MW] -> bool[M] (bit j-1 = position j kept)."""
+        idx = np.arange(self.M)
+        shifts = jnp.asarray(idx % 32, jnp.uint32)
+        return ((mask_words[idx // 32] >> shifts) & 1).astype(jnp.bool_)
+
+    def _bits_to_words(self, bits: jax.Array) -> jax.Array:
+        """bool[M] -> u32[MW]."""
+        padded = jnp.zeros((self.MW * 32,), jnp.uint32).at[: self.M].set(
+            bits.astype(jnp.uint32)
+        )
+        shifted = padded.reshape(self.MW, 32) << jnp.arange(32, dtype=jnp.uint32)
+        return shifted.sum(axis=1, dtype=jnp.uint32)
+
+    def _compact_keep(self, keys: jax.Array, readpos: jax.Array) -> jax.Array:
+        """CompactMessages as a position mask (compaction.tla:107-119).
+
+        keep[i] over 1..readPosition: null-key kept iff RetainNullKey;
+        otherwise kept iff i is the last occurrence of its key in the prefix
+        (== ``latestForKey[key]``, compaction.tla:98,114).
+        """
+        pos = self._pos
+        in_range = pos <= readpos
+        eq = keys[None, :] == keys[:, None]  # [i, j]
+        later_same = eq & (pos[None, :] > pos[:, None]) & in_range[None, :]
+        is_latest = in_range & (keys != 0) & ~jnp.any(later_same, axis=1)
+        null_keep = in_range & (keys == 0) & self.c.retain_null_key
+        return is_latest | null_keep
+
+    # ------------------------------------------------------------------
+    # initial states (compaction.tla:188-202)
+    # ------------------------------------------------------------------
+
+    @property
+    def n_initial(self) -> int:
+        if self.c.model_producer:
+            return 1
+        return self.kv ** self.M
+
+    def gen_initial(self, idx: jax.Array) -> SState:
+        """Initial state #idx (mixed-radix decode of the Init fanout).
+
+        With ModelProducer=FALSE, Init draws ``messages`` from all
+        id-consistent length-M sequences (compaction.tla:191-194); state #idx
+        has position i's (key, value) given by digit i of idx in base
+        ``|KeySet|*|ValueSet|``.  With ModelProducer=TRUE there is a single
+        initial state with ``messages = <<>>`` (compaction.tla:189-190).
+        """
+        zero = jnp.int32(0)
+        if self.c.model_producer:
+            length = zero
+            keys = jnp.zeros((self.M,), jnp.int32)
+            vals = jnp.zeros((self.M,), jnp.int32)
+        else:
+            digits = []
+            x = idx.astype(jnp.int32)
+            for _ in range(self.M):
+                digits.append(x % self.kv)
+                x = x // self.kv
+            d = jnp.stack(digits) if self.M else jnp.zeros((0,), jnp.int32)
+            keys = d // (self.c.num_values + 1)
+            vals = d % (self.c.num_values + 1)
+            length = jnp.int32(self.M)
+        return SState(
+            length=length,
+            keys=keys,
+            vals=vals,
+            led_present=jnp.zeros((self.C,), jnp.int32),
+            led_mask=jnp.zeros((self.C, self.MW), jnp.uint32),
+            cursor_present=zero,
+            cursor_h=zero,
+            cursor_c=zero,
+            cstate=jnp.int32(pyeval.PHASE_ONE),
+            p1_present=zero,
+            p1_readpos=zero,
+            horizon=zero,
+            context=zero,
+            crash=zero,
+            consume=zero,
+        )
+
+    # ------------------------------------------------------------------
+    # actions (compaction.tla:216-231); each returns (valid, successor)
+    # ------------------------------------------------------------------
+
+    def _producer(self, s: SState, key: int, val: int) -> Tuple[jax.Array, SState]:
+        """Producer, one (inputKey, inputValue) lane (compaction.tla:83-87)."""
+        valid = s.length < self.M
+        at_new = self._pos == s.length + 1
+        return valid, s._replace(
+            length=s.length + 1,
+            keys=jnp.where(at_new, jnp.int32(key), s.keys),
+            vals=jnp.where(at_new, jnp.int32(val), s.vals),
+        )
+
+    def _phase_one(self, s: SState) -> Tuple[jax.Array, SState]:
+        """CompactorPhaseOne (compaction.tla:93-100).  latestForKey is not
+        materialized — it is derivable from (messages, readPosition); only
+        the snapshot position is recorded (see ops/packing.py docstring)."""
+        valid = (
+            (s.cstate == pyeval.PHASE_ONE) & (s.p1_present == 0) & (s.length > 0)
+        )
+        return valid, s._replace(
+            p1_present=jnp.int32(1),
+            p1_readpos=s.length,
+            cstate=jnp.int32(pyeval.PHASE_TWO_WRITE),
+        )
+
+    def _phase_two_write(self, s: SState) -> Tuple[jax.Array, SState]:
+        """CompactorPhaseTwoWrite (compaction.tla:121-132)."""
+        max_id = self._max_led_id(s.led_present)
+        new_id = max_id + 1
+        valid = (
+            (s.p1_present == 1)
+            & (s.cstate == pyeval.PHASE_TWO_WRITE)
+            & (new_id <= self.C)
+        )
+        keep = self._compact_keep(s.keys, s.p1_readpos)
+        words = self._bits_to_words(keep)
+        slot = jnp.clip(new_id - 1, 0, max(self.C - 1, 0))
+        slot_onehot = jnp.arange(self.C, dtype=jnp.int32) == slot
+        return valid, s._replace(
+            led_present=jnp.where(slot_onehot, 1, s.led_present),
+            led_mask=jnp.where(slot_onehot[:, None], words[None, :], s.led_mask),
+            cstate=jnp.int32(pyeval.PHASE_TWO_UPDATE_CONTEXT),
+        )
+
+    def _update_context(self, s: SState) -> Tuple[jax.Array, SState]:
+        """CompactorPhaseTwoUpdateContext (compaction.tla:135-139)."""
+        valid = s.cstate == pyeval.PHASE_TWO_UPDATE_CONTEXT
+        return valid, s._replace(
+            context=self._max_led_id(s.led_present),
+            cstate=jnp.int32(pyeval.PHASE_TWO_UPDATE_HORIZON),
+        )
+
+    def _update_horizon(self, s: SState) -> Tuple[jax.Array, SState]:
+        """CompactorPhaseTwoUpdateHorizon (compaction.tla:141-145)."""
+        valid = s.cstate == pyeval.PHASE_TWO_UPDATE_HORIZON
+        return valid, s._replace(
+            horizon=s.p1_readpos,
+            cstate=jnp.int32(pyeval.PHASE_TWO_PERSIST_CURSOR),
+        )
+
+    def _persist_cursor(self, s: SState) -> Tuple[jax.Array, SState]:
+        """CompactorPhaseTwoPersistCusror [sic] (compaction.tla:147-151)."""
+        valid = s.cstate == pyeval.PHASE_TWO_PERSIST_CURSOR
+        return valid, s._replace(
+            cursor_present=jnp.int32(1),
+            cursor_h=s.horizon,
+            cursor_c=s.context,
+            cstate=jnp.int32(pyeval.PHASE_TWO_DELETE_LEDGER),
+        )
+
+    def _delete_ledger(self, s: SState) -> Tuple[jax.Array, SState]:
+        """CompactorPhaseTwoDeleteLedger (compaction.tla:153-165): deletes the
+        second-to-last compacted ledger (explicit simplification at
+        compaction.tla:159), resets to PhaseOne, clears phaseOneResult."""
+        valid = s.cstate == pyeval.PHASE_TWO_DELETE_LEDGER
+        max_id = self._max_led_id(s.led_present)
+        old_slot = jnp.clip(max_id - 2, 0, max(self.C - 1, 0))  # 0-based
+        do_del = max_id >= 2
+        onehot = (jnp.arange(self.C, dtype=jnp.int32) == old_slot) & do_del
+        return valid, s._replace(
+            led_present=jnp.where(onehot, 0, s.led_present),
+            led_mask=jnp.where(onehot[:, None], jnp.uint32(0), s.led_mask),
+            cstate=jnp.int32(pyeval.PHASE_ONE),
+            p1_present=jnp.int32(0),
+            p1_readpos=jnp.int32(0),
+        )
+
+    def _broker_crash(self, s: SState) -> Tuple[jax.Array, SState]:
+        """BrokerCrash (compaction.tla:169-182): fault injection + recovery
+        from the durable cursor (0/0 cold start when cursor = Nil)."""
+        valid = s.crash < self.c.max_crash_times
+        return valid, s._replace(
+            crash=s.crash + 1,
+            cstate=jnp.int32(pyeval.PHASE_ONE),
+            p1_present=jnp.int32(0),
+            p1_readpos=jnp.int32(0),
+            horizon=jnp.where(s.cursor_present == 1, s.cursor_h, 0),
+            context=jnp.where(s.cursor_present == 1, s.cursor_c, 0),
+        )
+
+    def successors(self, s: SState) -> Tuple[SState, jax.Array]:
+        """All non-stuttering Next lanes: (stacked SState [A], valid [A])."""
+        lanes: List[Tuple[jax.Array, SState]] = []
+        if self.c.model_producer:
+            for key in range(self.c.num_keys + 1):
+                for val in range(self.c.num_values + 1):
+                    lanes.append(self._producer(s, key, val))
+        lanes.append(self._phase_one(s))
+        lanes.append(self._phase_two_write(s))
+        lanes.append(self._update_context(s))
+        lanes.append(self._update_horizon(s))
+        lanes.append(self._persist_cursor(s))
+        lanes.append(self._delete_ledger(s))
+        lanes.append(self._broker_crash(s))
+        valid = jnp.stack([v for v, _ in lanes])
+        succ = jax.tree.map(lambda *xs: jnp.stack(xs), *[t for _, t in lanes])
+        return succ, valid
+
+    def stutter_enabled(self, s: SState) -> jax.Array:
+        """Enabledness of the stuttering disjuncts, for deadlock checking.
+
+        Consumer (compaction.tla:185-186, gate 229-230) and the Terminating
+        self-loop (compaction.tla:205-214).
+        """
+        consumer = jnp.bool_(self.c.model_consumer)
+        terminating = (
+            (s.length == self.M)
+            & (s.cstate == pyeval.PHASE_TWO_WRITE)
+            & (self._max_led_id(s.led_present) == self.C)
+            & (
+                (not self.c.model_consumer)
+                | (s.consume == self.c.consume_times_limit)
+            )
+        )
+        return consumer | terminating
+
+    # ------------------------------------------------------------------
+    # invariants (compaction.tla:236-294); True = satisfied
+    # ------------------------------------------------------------------
+
+    def type_safe(self, s: SState) -> jax.Array:
+        """TypeSafe (compaction.tla:236-248)."""
+        pos = self._pos
+        live = pos <= s.length
+        msgs_ok = jnp.all(
+            ~live
+            | (
+                (s.keys >= 0)
+                & (s.keys <= self.c.num_keys)
+                & (s.vals >= 0)
+                & (s.vals <= self.c.num_values)
+            )
+        )
+        # Ledger entries are (id=position, key, value) drawn from messages:
+        # well-typed iff every kept position is within the live prefix.
+        led_ok = jnp.bool_(True)
+        for cc in range(self.C):
+            bits = self._mask_bits(s.led_mask[cc])
+            in_prefix = jnp.all(~bits | live)
+            absent_clean = (s.led_present[cc] == 1) | ~jnp.any(bits)
+            led_ok = led_ok & in_prefix & absent_clean
+        p1_ok = (s.p1_present == 0) | (
+            (s.p1_readpos >= 1) & (s.p1_readpos <= s.length)
+        )
+        cursor_ok = (s.cursor_present == 0) | (
+            (s.cursor_h >= 1)
+            & (s.cursor_h <= self.M)
+            & (s.cursor_c >= 1)
+            & (s.cursor_c <= self.C)
+        )
+        ranges_ok = (
+            (s.cstate >= 0)
+            & (s.cstate <= 5)
+            & (s.horizon >= 0)
+            & (s.horizon <= self.M)
+            & (s.context >= 0)
+            & (s.context <= self.C)
+            & (s.crash >= 0)
+            & (s.crash <= self.c.max_crash_times)
+        )
+        return msgs_ok & led_ok & p1_ok & cursor_ok & ranges_ok
+
+    def compacted_ledger_leak(self, s: SState) -> jax.Array:
+        """CompactedLedgerLeak (compaction.tla:251-253): <= 2 live ledgers."""
+        return jnp.sum(s.led_present) <= 2
+
+    def _context_ledger_bits(self, s: SState) -> jax.Array:
+        """bool[M] kept-position mask of compactedLedgers[compactedTopicContext];
+        all-false when context = 0 or the slot is Nil (the TLC out-of-domain
+        case, never forced on reachable states — SURVEY.md C23)."""
+        if self.C == 0:
+            return jnp.zeros((self.M,), jnp.bool_)
+        slot = jnp.clip(s.context - 1, 0, self.C - 1)
+        words = s.led_mask[slot]
+        present = (s.context >= 1) & (
+            jnp.take(s.led_present, slot, axis=0) == 1
+        )
+        return self._mask_bits(words) & present
+
+    def compaction_horizon_correctness(self, s: SState) -> jax.Array:
+        """CompactionHorizonCorrectness (compaction.tla:259-274).
+
+        For every message position i <= compactionHorizon that survives the
+        null-key filter, some entry of the context ledger must have the same
+        key and id >= i.  Ledger entry ids are positions, so the \\E j over
+        the ledger becomes: exists kept position j with keys[j] = keys[i]
+        and j >= i.  The horizon = 0 case is vacuous by construction (the
+        i-mask is empty), preserving TLC's lazy LET semantics.
+        """
+        pos = self._pos
+        led = self._context_ledger_bits(s)
+        needed = (pos <= s.horizon) & (
+            (s.keys != 0) | jnp.bool_(self.c.retain_null_key)
+        )
+        same_key = s.keys[None, :] == s.keys[:, None]  # [i, j]
+        ok_i = jnp.any(
+            led[None, :] & same_key & (pos[None, :] >= pos[:, None]), axis=1
+        )
+        return jnp.all(~needed | ok_i)
+
+    def duplicate_null_key_message(self, s: SState) -> jax.Array:
+        """DuplicateNullKeyMessage (compaction.tla:280-294).
+
+        Spec form: no null-key entry of the context ledger may equal any
+        messagesAfterHorizon[j].  Entry equality of message records includes
+        the positional id, so ledger entry at position p equals a
+        post-horizon message iff p > horizon (content at a position is
+        immutable).  Hence: violated iff some kept null-key position of the
+        context ledger lies beyond the horizon.
+        """
+        if not self.c.retain_null_key:
+            return jnp.bool_(True)
+        pos = self._pos
+        led = self._context_ledger_bits(s)
+        dup = jnp.any(led & (s.keys == 0) & (pos > s.horizon))
+        return ~((s.context != 0) & dup)
+
+    @property
+    def invariants(self) -> Dict[str, Callable[[SState], jax.Array]]:
+        return {
+            "TypeSafe": self.type_safe,
+            "CompactedLedgerLeak": self.compacted_ledger_leak,
+            "CompactionHorizonCorrectness": self.compaction_horizon_correctness,
+            "DuplicateNullKeyMessage": self.duplicate_null_key_message,
+        }
+
+    # ------------------------------------------------------------------
+    # host-side conversions to/from the oracle's structural states
+    # ------------------------------------------------------------------
+
+    def to_pystate(self, s) -> pyeval.State:
+        """SState (host numpy values, single state) -> pyeval.State."""
+        g = lambda x: np.asarray(x)
+        length = int(g(s.length))
+        keys = g(s.keys)
+        vals = g(s.vals)
+        messages = tuple(
+            (i + 1, int(keys[i]), int(vals[i])) for i in range(length)
+        )
+        ledgers = []
+        for cc in range(self.C):
+            if int(g(s.led_present)[cc]) == 0:
+                ledgers.append(None)
+            else:
+                words = g(s.led_mask)[cc]
+                entries = tuple(
+                    messages[j]
+                    for j in range(length)
+                    if (int(words[j // 32]) >> (j % 32)) & 1
+                )
+                ledgers.append(entries)
+        cursor = (
+            (int(g(s.cursor_h)), int(g(s.cursor_c)))
+            if int(g(s.cursor_present))
+            else None
+        )
+        if int(g(s.p1_present)):
+            rp = int(g(s.p1_readpos))
+            latest: dict = {}
+            for j in range(1, rp + 1):
+                k = int(keys[j - 1])
+                if k != 0:
+                    latest[k] = j
+            p1 = (rp, tuple(sorted(latest.items())))
+        else:
+            p1 = None
+        return pyeval.State(
+            messages=messages,
+            ledgers=tuple(ledgers),
+            cursor=cursor,
+            cstate=int(g(s.cstate)),
+            p1=p1,
+            horizon=int(g(s.horizon)),
+            context=int(g(s.context)),
+            crash=int(g(s.crash)),
+            consume=int(g(s.consume)),
+        )
+
+    def from_pystate(self, ps: pyeval.State) -> SState:
+        """pyeval.State -> SState (numpy scalars/arrays, single state)."""
+        length = len(ps.messages)
+        keys = np.zeros((self.M,), np.int32)
+        vals = np.zeros((self.M,), np.int32)
+        for i, (mid, k, v) in enumerate(ps.messages):
+            assert mid == i + 1, "ids must be positional"
+            keys[i] = k
+            vals[i] = v
+        led_present = np.zeros((self.C,), np.int32)
+        led_mask = np.zeros((self.C, self.MW), np.uint32)
+        for cc, led in enumerate(ps.ledgers):
+            if led is None:
+                continue
+            led_present[cc] = 1
+            for mid, k, v in led:
+                j = mid - 1
+                assert ps.messages[j] == (mid, k, v), "ledger entry must match prefix"
+                led_mask[cc, j // 32] |= np.uint32(1 << (j % 32))
+        if ps.p1 is not None:
+            p1_present, p1_readpos = 1, ps.p1[0]
+        else:
+            p1_present, p1_readpos = 0, 0
+        if ps.cursor is not None:
+            cursor_present, cursor_h, cursor_c = 1, ps.cursor[0], ps.cursor[1]
+        else:
+            cursor_present, cursor_h, cursor_c = 0, 0, 0
+        i32 = np.int32
+        return SState(
+            length=i32(length),
+            keys=keys,
+            vals=vals,
+            led_present=led_present,
+            led_mask=led_mask,
+            cursor_present=i32(cursor_present),
+            cursor_h=i32(cursor_h),
+            cursor_c=i32(cursor_c),
+            cstate=i32(ps.cstate),
+            p1_present=i32(p1_present),
+            p1_readpos=i32(p1_readpos),
+            horizon=i32(ps.horizon),
+            context=i32(ps.context),
+            crash=i32(ps.crash),
+            consume=i32(ps.consume),
+        )

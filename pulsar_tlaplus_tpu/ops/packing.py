@@ -1,0 +1,210 @@
+"""Packed-state codec: fixed-width bit layouts over uint32 word vectors.
+
+SURVEY.md §7-L0.  Every TLA+ state of the ``compaction`` spec is encoded into
+``W`` uint32 words with a layout derived statically from the model constants.
+The encoding is *canonical* (equal TLA+ states <-> equal words) and *compact*:
+
+- ``messages`` (compaction.tla:57): ids are positional (``Producer`` appends
+  ``id = Len+1`` at compaction.tla:86; pre-generated Init forces ``id = i`` at
+  compaction.tla:194), so only ``(key, value)`` per position plus a length are
+  stored.
+- ``compactedLedgers`` (compaction.tla:58-59): messages are append-only, so a
+  compacted ledger — a subsequence of a past message prefix — is stored as a
+  per-slot *bitmask over message positions* plus a presence bit.  Distinct
+  masks give distinct sequences (entries carry distinct positional ids), and
+  the mask plus the current ``messages`` array reconstructs the sequence
+  exactly, so the encoding is bijective on reachable states.
+- ``phaseOneResult`` (compaction.tla:64): ``latestForKey`` is a deterministic
+  function of ``messages[1..readPosition]`` (compaction.tla:97-98) and
+  ``messages`` is append-only, so only ``(present, readPosition)`` is stored.
+- ``cursor`` (compaction.tla:60): presence bit + two small ints.
+
+Canonical-form obligations on writers (kernels must maintain these so that
+packing is injective):
+- ``keys[i] = vals[i] = 0`` for positions ``i >= length``;
+- ``led_mask[c] = 0`` whenever ``led_present[c] = 0``;
+- ``p1_readpos = 0`` whenever ``p1_present = 0``;
+- ``cursor_h = cursor_c = 0`` whenever ``cursor_present = 0``.
+
+No 64-bit integer types are used anywhere (TPU-friendly; jax x64 stays off).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+
+def bitlen(n: int) -> int:
+    """Bits needed to represent values 0..n (0 -> 0 bits)."""
+    return n.bit_length()
+
+
+class SState(NamedTuple):
+    """Struct-of-scalars state (one TLA+ state; batch via vmap).
+
+    Mirrors the 10 VARIABLES of compaction.tla:56-70 under the compressed
+    encoding documented in the module docstring.
+    """
+
+    length: jax.Array  # i32 scalar: Len(messages), 0..M
+    keys: jax.Array  # i32[M]: message keys, 0 = NullKey / padding
+    vals: jax.Array  # i32[M]: message values, 0 = NullValue / padding
+    led_present: jax.Array  # i32[C]: 1 if compactedLedgers[c+1] # Nil
+    led_mask: jax.Array  # u32[C, MW]: kept-position bitmask per ledger slot
+    cursor_present: jax.Array  # i32 scalar
+    cursor_h: jax.Array  # i32 scalar: cursor.compactionHorizon
+    cursor_c: jax.Array  # i32 scalar: cursor.compactedTopicContext
+    cstate: jax.Array  # i32 scalar: 0..5 (compaction.tla:38-44 order)
+    p1_present: jax.Array  # i32 scalar
+    p1_readpos: jax.Array  # i32 scalar: phaseOneResult.readPosition
+    horizon: jax.Array  # i32 scalar: compactionHorizon
+    context: jax.Array  # i32 scalar: compactedTopicContext
+    crash: jax.Array  # i32 scalar: crashTimes
+    consume: jax.Array  # i32 scalar: consumeTimes
+
+
+class Layout:
+    """Static bit layout for a given ``Constants``; pack/unpack kernels."""
+
+    def __init__(self, c: Constants):
+        self.c = c
+        m = c.message_sent_limit
+        self.M = m
+        self.C = c.compaction_times_limit
+        self.MW = max(1, math.ceil(m / 32))  # mask words per ledger slot
+        self.kb = bitlen(c.num_keys)
+        self.vb = bitlen(c.num_values)
+        self.mb = bitlen(m)
+        self.cb = bitlen(self.C)
+        self.crb = bitlen(c.max_crash_times)
+        self.cob = bitlen(c.consume_times_limit) if c.model_consumer else 0
+        self.total_bits = (
+            self.mb
+            + m * (self.kb + self.vb)
+            + self.C * (1 + m)
+            + (1 + self.mb + self.cb)  # cursor
+            + 3  # cstate
+            + (1 + self.mb)  # phaseOneResult
+            + self.mb  # horizon
+            + self.cb  # context
+            + self.crb
+            + self.cob
+        )
+        self.W = max(1, math.ceil(self.total_bits / 32))
+
+    # -- stream construction -------------------------------------------------
+
+    def _items(self, s: SState):
+        """Ordered (scalar, width) stream defining the bit layout."""
+        items = [(s.length, self.mb)]
+        for i in range(self.M):
+            items.append((s.keys[i], self.kb))
+        for i in range(self.M):
+            items.append((s.vals[i], self.vb))
+        for cc in range(self.C):
+            items.append((s.led_present[cc], 1))
+            rem = self.M
+            for w in range(self.MW):
+                width = min(32, rem)
+                if width > 0:
+                    items.append((s.led_mask[cc, w], width))
+                rem -= width
+        items.append((s.cursor_present, 1))
+        items.append((s.cursor_h, self.mb))
+        items.append((s.cursor_c, self.cb))
+        items.append((s.cstate, 3))
+        items.append((s.p1_present, 1))
+        items.append((s.p1_readpos, self.mb))
+        items.append((s.horizon, self.mb))
+        items.append((s.context, self.cb))
+        items.append((s.crash, self.crb))
+        items.append((s.consume, self.cob))
+        return items
+
+    def pack(self, s: SState) -> jax.Array:
+        """One state -> u32[W].  vmap for batches."""
+        words = [jnp.uint32(0)] * self.W
+        pos = 0
+        for val, width in self._items(s):
+            if width == 0:
+                continue
+            mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+            v = val.astype(jnp.uint32) & mask
+            w, off = divmod(pos, 32)
+            words[w] = words[w] | (v << jnp.uint32(off))
+            if off + width > 32:
+                words[w + 1] = words[w + 1] | (v >> jnp.uint32(32 - off))
+            pos += width
+        return jnp.stack(words)
+
+    def unpack(self, words: jax.Array) -> SState:
+        """u32[W] -> one state.  vmap for batches."""
+        pos = 0
+
+        def read(width: int) -> jax.Array:
+            nonlocal pos
+            if width == 0:
+                return jnp.int32(0)
+            w, off = divmod(pos, 32)
+            lo = words[w] >> jnp.uint32(off)
+            if off + width > 32:
+                lo = lo | (words[w + 1] << jnp.uint32(32 - off))
+            mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+            pos += width
+            return lo & mask
+
+        length = read(self.mb).astype(jnp.int32)
+        keys = jnp.stack([read(self.kb).astype(jnp.int32) for _ in range(self.M)]) if self.M else jnp.zeros((0,), jnp.int32)
+        vals = jnp.stack([read(self.vb).astype(jnp.int32) for _ in range(self.M)]) if self.M else jnp.zeros((0,), jnp.int32)
+        led_present = []
+        led_mask = []
+        for _cc in range(self.C):
+            led_present.append(read(1).astype(jnp.int32))
+            rem = self.M
+            mws = []
+            for _w in range(self.MW):
+                width = min(32, rem)
+                mws.append(read(width).astype(jnp.uint32) if width > 0 else jnp.uint32(0))
+                rem -= width
+            led_mask.append(jnp.stack(mws))
+        led_present = (
+            jnp.stack(led_present) if self.C else jnp.zeros((0,), jnp.int32)
+        )
+        led_mask = (
+            jnp.stack(led_mask)
+            if self.C
+            else jnp.zeros((0, self.MW), jnp.uint32)
+        )
+        cursor_present = read(1).astype(jnp.int32)
+        cursor_h = read(self.mb).astype(jnp.int32)
+        cursor_c = read(self.cb).astype(jnp.int32)
+        cstate = read(3).astype(jnp.int32)
+        p1_present = read(1).astype(jnp.int32)
+        p1_readpos = read(self.mb).astype(jnp.int32)
+        horizon = read(self.mb).astype(jnp.int32)
+        context = read(self.cb).astype(jnp.int32)
+        crash = read(self.crb).astype(jnp.int32)
+        consume = read(self.cob).astype(jnp.int32)
+        return SState(
+            length,
+            keys,
+            vals,
+            led_present,
+            led_mask,
+            cursor_present,
+            cursor_h,
+            cursor_c,
+            cstate,
+            p1_present,
+            p1_readpos,
+            horizon,
+            context,
+            crash,
+            consume,
+        )

@@ -1,0 +1,32 @@
+"""Device-mesh helpers for the sharded checker (SURVEY.md §2.2-E11).
+
+One logical axis ``"shard"`` carries both parallelism dimensions of this
+workload (SURVEY.md §2 parallelism inventory): frontier data-parallelism
+(successor/invariant kernels) and fingerprint-space sharding (each device
+owns the visited-set partition ``key % n_devices``).  Within a slice the
+routing collective rides ICI; across slices the same program extends over
+DCN via multi-slice meshes — no NCCL/MPI anywhere, JAX collectives are the
+entire comm layer.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(for CPU testing set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+            )
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (AXIS,))

@@ -1,0 +1,84 @@
+"""Shared helpers for differential tests: oracle BFS sampling and
+counterexample-trace validation."""
+
+import random
+
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+
+def assert_valid_counterexample(c, trace, trace_actions, invariant):
+    """A counterexample must start at an initial state, follow real
+    transitions (named actions must map to the oracle's successors), satisfy
+    the invariant at every non-final state, and violate it at the end."""
+    assert trace and trace[0] in set(pe.initial_states(c))
+    inv = pe.INVARIANTS[invariant]
+    for s, act, t in zip(trace, trace_actions, trace[1:]):
+        act_name = act if isinstance(act, str) else pe.ACTION_NAMES[act]
+        succ = {}
+        for a, st in pe.successors(c, s):
+            succ.setdefault(pe.ACTION_NAMES[a], []).append(st)
+        assert t in succ.get(act_name, []), (act_name, s)
+        assert inv(c, s), "only the final state may violate"
+    assert not inv(c, trace[-1])
+
+
+def oracle_sample(c, n_states=150, levels=8, seed=0):
+    """A deterministic sample of reachable states, spread across BFS depth."""
+    seen = {}
+    frontier = []
+    for s in pe.initial_states(c):
+        if s not in seen:
+            seen[s] = None
+            frontier.append(s)
+    for _ in range(levels):
+        new = []
+        for s in frontier:
+            for _a, t in pe.successors(c, s):
+                if t not in seen:
+                    seen[t] = None
+                    new.append(t)
+        if not new:
+            break
+        frontier = new
+    rng = random.Random(seed)
+    pool = list(seen)
+    return rng.sample(pool, min(n_states, len(pool)))
+
+
+# Small configurations exercising distinct semantic corners (cheap enough
+# for exhaustive engine-vs-oracle runs on the CPU backend).
+SMALL_CONFIGS = {
+    "shipped": pe.SHIPPED_CFG,
+    "producer_on": pe.Constants(
+        message_sent_limit=2,
+        compaction_times_limit=2,
+        num_keys=1,
+        num_values=1,
+        max_crash_times=1,
+        model_producer=True,
+    ),
+    "no_retain": pe.Constants(
+        message_sent_limit=3,
+        compaction_times_limit=2,
+        num_keys=2,
+        num_values=1,
+        retain_null_key=False,
+        max_crash_times=1,
+    ),
+    "two_crashes": pe.Constants(
+        message_sent_limit=2,
+        compaction_times_limit=3,
+        num_keys=1,
+        num_values=2,
+        max_crash_times=2,
+    ),
+    "wide_mask": pe.Constants(
+        # message positions spill into a second 32-bit mask word only when
+        # M > 32; keep a cheap variant that still crosses field boundaries.
+        message_sent_limit=4,
+        compaction_times_limit=2,
+        num_keys=3,
+        num_values=1,
+        max_crash_times=1,
+    ),
+}

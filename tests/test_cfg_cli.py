@@ -1,0 +1,94 @@
+"""Config front-end and CLI tests (SURVEY.md §1-L4, §5 config system)."""
+
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+from pulsar_tlaplus_tpu.ref.pyeval import SHIPPED_CFG
+from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+# Semantically identical to the reference compaction.cfg (string KeySpace,
+# model-value block, commented-out bug invariants), written independently.
+SHIPPED_LIKE = """
+CONSTANTS
+    MessageSentLimit = 3,
+    CompactionTimesLimit = 3,
+    ModelConsumer = FALSE,
+    ConsumeTimesLimit = 2,
+    KeySpace = {"key1", "key2"},
+    ValueSpace = {1, 2},
+    RetainNullKey = TRUE,
+    MaxCrashTimes = 1,
+    ModelProducer = FALSE
+
+CONSTANTS
+    Nil = Nil,
+    Compactor_In_PhaseOne = Compactor_In_PhaseOne
+
+SPECIFICATION Spec
+
+INVARIANTS
+    TypeSafe,
+    \\* CompactedLedgerLeak,
+    CompactionHorizonCorrectness
+"""
+
+
+def test_parse_shipped_like_cfg():
+    cfg = cfgmod.parse_cfg(SHIPPED_LIKE)
+    assert cfg.specification == "Spec"
+    assert cfg.invariants == ["TypeSafe", "CompactionHorizonCorrectness"]
+    assert "Nil" in cfg.model_values
+    assert cfg.constants["MessageSentLimit"] == 3
+    assert cfg.constants["KeySpace"] == frozenset({"key1", "key2"})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        constants = cfgmod.to_constants(cfg)
+        # the string-key ASSUME discrepancy must be diagnosed, not silent
+        assert any("SUBSET Nat" in str(x.message) for x in w)
+    assert constants == SHIPPED_CFG
+
+
+def test_integer_keyspace_strict():
+    cfg = cfgmod.parse_cfg(SHIPPED_LIKE.replace('{"key1", "key2"}', "{1, 2}"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        constants = cfgmod.to_constants(cfg)
+        assert not w  # dense 1..n integer space needs no diagnostics
+    assert constants == SHIPPED_CFG
+
+
+def test_zero_in_keyspace_rejected():
+    cfg = cfgmod.parse_cfg(SHIPPED_LIKE.replace('{"key1", "key2"}', "{0, 1}"))
+    with pytest.raises(ValueError, match="reserved"):
+        cfgmod.to_constants(cfg)
+
+
+def test_missing_constant_rejected():
+    cfg = cfgmod.parse_cfg(SHIPPED_LIKE.replace("MaxCrashTimes = 1,", ""))
+    with pytest.raises(ValueError, match="MaxCrashTimes"):
+        cfgmod.to_constants(cfg)
+
+
+def test_cli_end_to_end(tmp_path):
+    """CLI on a small producer-on model: clean run, TLC-style summary."""
+    spec = tmp_path / "compaction.tla"
+    spec.write_text("---- MODULE compaction ----\n====\n")  # registry stub
+    cfg = tmp_path / "compaction.cfg"
+    cfg.write_text(
+        SHIPPED_LIKE.replace("MessageSentLimit = 3", "MessageSentLimit = 2")
+        .replace('{"key1", "key2"}', "{1}")
+        .replace("ValueSpace = {1, 2}", "ValueSpace = {1}")
+        .replace("CompactionTimesLimit = 3", "CompactionTimesLimit = 2")
+        .replace("ModelProducer = FALSE", "ModelProducer = TRUE")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "pulsar_tlaplus_tpu.cli", "check", str(spec), "-cpu", "-chunk", "256"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "distinct states found" in proc.stdout

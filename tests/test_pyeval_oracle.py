@@ -1,0 +1,65 @@
+"""Oracle evaluator vs the reference's published ground truth (SURVEY.md §4.3).
+
+The two distinct-state counts in the spec comment (compaction.tla:23) are the
+only quantitative oracles the reference publishes; the two commented-out
+invariants (compaction.cfg:27-31) are its known-bug regression fixtures.
+"""
+
+import dataclasses
+
+import pytest
+
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+
+def test_shipped_cfg_state_count():
+    r = pe.check(pe.SHIPPED_CFG)
+    assert r.distinct_states == 45198  # compaction.tla:23
+    assert r.diameter == 20
+    assert r.violation is None
+
+
+def test_modeled_producer_consumer_state_count():
+    # The 253,361 figure (compaction.tla:23) corresponds to
+    # RetainNullKey=FALSE; see BASELINE.md and the round-1 survey note.
+    c = dataclasses.replace(
+        pe.SHIPPED_CFG,
+        model_producer=True,
+        model_consumer=True,
+        retain_null_key=False,
+    )
+    r = pe.check(c, invariants=())
+    assert r.distinct_states == 253361
+    assert r.diameter == 23
+
+
+def test_compacted_ledger_leak_counterexample():
+    from tests.helpers import assert_valid_counterexample
+
+    r = pe.check(pe.SHIPPED_CFG, invariants=("CompactedLedgerLeak",))
+    assert r.violation == "CompactedLedgerLeak"
+    assert r.diameter == 12
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r.trace, r.trace_actions, "CompactedLedgerLeak"
+    )
+
+
+def test_duplicate_null_key_counterexample():
+    from tests.helpers import assert_valid_counterexample
+
+    r = pe.check(pe.SHIPPED_CFG, invariants=("DuplicateNullKeyMessage",))
+    assert r.violation == "DuplicateNullKeyMessage"
+    assert r.diameter == 4
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r.trace, r.trace_actions, "DuplicateNullKeyMessage"
+    )
+
+
+def test_assume_validation():
+    with pytest.raises(ValueError):
+        pe.check(dataclasses.replace(pe.SHIPPED_CFG, message_sent_limit=-1))
+
+
+def test_state_explosion_guard():
+    with pytest.raises(RuntimeError):
+        pe.check(pe.SHIPPED_CFG, invariants=(), max_states=100)
