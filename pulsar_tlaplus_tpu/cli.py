@@ -55,6 +55,35 @@ def main(argv=None):
         help="disable deadlock checking (TLC: -deadlock)",
     )
     pc.add_argument(
+        "-property",
+        dest="liveness_property",
+        metavar="NAME",
+        help="check a liveness property (e.g. Termination) instead of invariants",
+    )
+    pc.add_argument(
+        "-fairness",
+        choices=["none", "wf_next"],
+        default="none",
+        help="fairness assumption for -property (default: none, like the raw Spec)",
+    )
+    pc.add_argument(
+        "-simulate",
+        type=int,
+        default=0,
+        metavar="N",
+        help="simulation mode: N random walkers instead of exhaustive BFS",
+    )
+    pc.add_argument("-depth", type=int, default=64, help="simulation depth")
+    pc.add_argument(
+        "-metrics", help="write per-level JSONL metrics to this file"
+    )
+    pc.add_argument(
+        "-checkpoint", help="checkpoint file (.npz); resume with -recover"
+    )
+    pc.add_argument(
+        "-recover", action="store_true", help="resume from -checkpoint"
+    )
+    pc.add_argument(
         "-cpu", action="store_true", help="force the CPU backend"
     )
     pc.add_argument("-chunk", type=int, default=4096)
@@ -97,7 +126,50 @@ def main(argv=None):
         f"{model.A} successor lanes; invariants: {list(invariants) or 'none'})"
     )
     t0 = time.time()
+    if args.liveness_property:
+        from pulsar_tlaplus_tpu.engine.liveness import LivenessChecker
+
+        try:
+            lck = LivenessChecker(
+                model,
+                goal=args.liveness_property,
+                fairness=args.fairness,
+                frontier_chunk=args.chunk,
+            )
+        except ValueError as e:
+            sys.exit(f"tpu-tlc: {e}")
+        lres = lck.run()
+        verdict = "satisfied" if lres.holds else "VIOLATED"
+        print(
+            f"Temporal property {args.liveness_property} "
+            f"(fairness={args.fairness}): {verdict} — {lres.reason}"
+        )
+        print(f"{lres.distinct_states} distinct states examined.")
+        return 0 if lres.holds else 1
+    if args.simulate:
+        from pulsar_tlaplus_tpu.engine.simulate import Simulator
+
+        sres = Simulator(
+            model,
+            invariants=invariants,
+            n_walkers=args.simulate,
+            depth=args.depth,
+        ).run()
+        if sres.violation:
+            print(f"Error: Invariant {sres.violation} is violated.")
+            print("The behavior up to this point is:")
+            print(render_trace(sres.trace, sres.trace_actions, constants))
+        print(
+            f"Simulation: {sres.n_walkers} behaviors of depth {sres.depth} "
+            f"({sres.states_visited} states visited)."
+        )
+        return 1 if sres.violation else 0
     if args.sharded:
+        if args.recover or args.checkpoint or args.metrics:
+            sys.exit(
+                "tpu-tlc: -checkpoint/-recover/-metrics are not supported "
+                "with -sharded yet"
+            )
         from pulsar_tlaplus_tpu.engine.sharded import ShardedChecker
 
         ck = ShardedChecker(
@@ -118,8 +190,20 @@ def main(argv=None):
             frontier_chunk=args.chunk,
             max_states=args.maxstates,
             progress=True,
+            metrics_path=args.metrics,
+            checkpoint_path=args.checkpoint,
         )
-    r = ck.run()
+    if args.recover and (
+        not args.checkpoint or not os.path.exists(args.checkpoint)
+    ):
+        sys.exit(
+            f"tpu-tlc: -recover needs an existing -checkpoint file "
+            f"(got: {args.checkpoint})"
+        )
+    try:
+        r = ck.run(resume=args.recover) if not args.sharded else ck.run()
+    except ValueError as e:
+        sys.exit(f"tpu-tlc: {e}")
     wall = time.time() - t0
     if r.violation and r.violation != "Deadlock":
         print(f"Error: Invariant {r.violation} is violated.")

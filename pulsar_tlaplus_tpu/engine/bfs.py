@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
+from pulsar_tlaplus_tpu.engine.statelog import FileLog, MemoryLog
 from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.ref import pyeval
@@ -66,6 +67,11 @@ class Checker:
         max_states: int = 200_000_000,
         time_budget_s: Optional[float] = None,
         progress: bool = False,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 5,
+        metrics_path: Optional[str] = None,
+        keep_log: bool = False,
+        state_log_path: Optional[str] = None,
     ):
         self.model = model
         self.layout = model.layout
@@ -75,6 +81,13 @@ class Checker:
         self.max_states = max_states
         self.time_budget_s = time_budget_s
         self.progress = progress
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.metrics_path = metrics_path
+        self.keep_log = keep_log
+        # disk-backed state log (native C++ store) for runs beyond host RAM
+        self.state_log_path = state_log_path
+        self.last_run_state: Optional[_RunState] = None
         self._cap = visited_cap
         self._jit_cache: Dict[Tuple[str, int], object] = {}
         self._unpack1 = jax.jit(self.layout.unpack)
@@ -168,98 +181,232 @@ class Checker:
             self._cap = cap
         return vk
 
-    def run(self) -> CheckerResult:
-        m = self.model
-        t0 = time.time()
-        vk = tuple(jnp.full((self._cap,), SENTINEL, jnp.uint32) for _ in range(3))
-        n_visited = 0
-        # Host-side (parent, action, packed) log for trace reconstruction.
-        all_packed: List[np.ndarray] = []
-        all_parent: List[np.ndarray] = []
-        all_action: List[np.ndarray] = []
-        n_total = 0
-        level_sizes: List[int] = []
-
-        def flush_chunk(out, frontier_gids, base_row) -> Tuple[int, Optional[Tuple[str, int]]]:
-            """Copy a step's new states to the host log; returns (n_new, violation)."""
-            nonlocal n_total
-            (packed, parent, action, n_new, nk1, nk2, nk3, viol) = out[:8]
-            n_new = int(n_new)
-            if n_new:
-                np_packed = np.asarray(packed[:n_new])
-                np_parent = np.asarray(parent[:n_new])
-                np_action = np.asarray(action[:n_new])
-                if frontier_gids is None:
-                    gids = np.full((n_new,), -1, np.int64)
-                else:
-                    gids = frontier_gids[base_row + np_parent]
-                all_packed.append(np_packed)
-                all_parent.append(gids)
-                all_action.append(np_action)
-            violation = None
-            viol = np.asarray(viol)
-            for i, name in enumerate(self.invariant_names):
-                if int(viol[i]) < n_new:
-                    violation = (name, n_total + int(viol[i]))
-                    break
-            n_total += n_new
-            return n_new, violation
-
-        def build_result(violation, deadlock_gid=None, deadlock=False, truncated=False):
-            wall = time.time() - t0
-            res = CheckerResult(
-                distinct_states=n_total,
-                diameter=len(level_sizes),
-                deadlock=deadlock,
-                wall_s=wall,
-                states_per_sec=n_total / max(wall, 1e-9),
-                level_sizes=level_sizes,
-                truncated=truncated,
-            )
-            gid = None
-            if violation is not None:
-                res.violation = violation[0]
-                gid = violation[1]
-            elif deadlock:
-                res.violation = "Deadlock"
-                gid = deadlock_gid
-            if gid is not None:
-                res.trace, res.trace_actions = build_trace(
-                    self.model, self._unpack1, gid, all_packed, all_parent, all_action
-                )
-            return res
-
-        # ---- level 1: initial states (compaction.tla:188-202) ----
-        n_init = m.n_initial
-        gen = jax.jit(
-            jax.vmap(lambda i: self.layout.pack(m.gen_initial(i)))
+    def _config_sig(self) -> str:
+        return repr(
+            (self.model.c, self.invariant_names, self.layout.total_bits)
         )
-        insert_new = 0
+
+    def _save_checkpoint(self, rs):
+        """Snapshot the full checker state (SURVEY.md §2.2-E8): sorted
+        visited keys + frontier + trace log; resume continues BFS.  With a
+        disk-backed state log only the (path, count) pair is recorded — the
+        log file itself is the durable storage."""
+        tmp = self.checkpoint_path + ".tmp.npz"
+        log = rs.log
+        if isinstance(log, FileLog):
+            log.sync()
+            log_arrays = dict(
+                log_path=np.frombuffer(log.path.encode(), dtype=np.uint8),
+                log_len=np.int64(len(log)),
+            )
+        else:
+            log_arrays = dict(
+                packed=log.packed_matrix(),
+                parent=log.parents(),
+                action=log.actions(),
+            )
+        np.savez_compressed(
+            tmp,
+            sig=np.frombuffer(self._config_sig().encode(), dtype=np.uint8),
+            vk0=np.asarray(rs.vk[0]), vk1=np.asarray(rs.vk[1]), vk2=np.asarray(rs.vk[2]),
+            n_visited=np.int64(rs.n_visited),
+            level_sizes=np.asarray(rs.level_sizes, np.int64),
+            frontier=rs.frontier,
+            frontier_gids=rs.frontier_gids,
+            **log_arrays,
+        )
+        import os
+
+        os.replace(tmp, self.checkpoint_path)
+
+    def load_checkpoint(self):
+        """Load a checkpoint dict (validates the config signature)."""
+        d = np.load(self.checkpoint_path)
+        sig = d["sig"].tobytes().decode()
+        if sig != self._config_sig():
+            raise ValueError(
+                "checkpoint was written by a different model configuration"
+            )
+        return d
+
+    def run(self, resume: bool = False) -> CheckerResult:
+        rs = _RunState()
+        rs.t0 = time.time()
+        if resume:
+            d = self.load_checkpoint()
+            self._cap = len(d["vk0"])
+            rs.vk = tuple(jnp.asarray(d[k]) for k in ("vk0", "vk1", "vk2"))
+            rs.n_visited = int(d["n_visited"])
+            if "log_path" in d:
+                path = d["log_path"].tobytes().decode()
+                rs.log = FileLog(path, self.layout.W)
+                if len(rs.log) < int(d["log_len"]):
+                    raise ValueError("state log shorter than checkpoint records")
+                rs.log.truncate(int(d["log_len"]))
+            else:
+                rs.log = MemoryLog(self.layout.W)
+                if len(d["packed"]):
+                    rs.log.append(d["packed"], d["parent"], d["action"])
+            rs.n_total = rs.n_visited
+            rs.level_sizes = [int(x) for x in d["level_sizes"]]
+            rs.frontier = d["frontier"]
+            rs.frontier_gids = d["frontier_gids"]
+            self._log(
+                rs,
+                f"resumed at level {len(rs.level_sizes)}: "
+                f"{rs.n_total} states, frontier {len(rs.frontier)}",
+            )
+            self._rewind_metrics(len(rs.level_sizes))
+            return self._bfs_loop(rs)
+        rs.vk = tuple(
+            jnp.full((self._cap,), SENTINEL, jnp.uint32) for _ in range(3)
+        )
+        rs.log = (
+            FileLog(self.state_log_path, self.layout.W)
+            if self.state_log_path
+            else MemoryLog(self.layout.W)
+        )
+        res = self._insert_initial(rs)
+        if res is not None:
+            return res
+        return self._bfs_loop(rs)
+
+    def _log(self, rs, msg):
+        if self.progress:
+            import sys
+
+            print(f"  {msg}", file=sys.stderr, flush=True)
+
+    def _flush_chunk(self, rs, out, frontier_gids, base_row):
+        """Copy a step's new states to the state log; returns
+        (n_new, violation, packed rows of the new states)."""
+        (packed, parent, action, n_new, _nk1, _nk2, _nk3, viol) = out[:8]
+        n_new = int(n_new)
+        np_packed = None
+        if n_new:
+            np_packed = np.asarray(packed[:n_new])
+            np_parent = np.asarray(parent[:n_new])
+            np_action = np.asarray(action[:n_new])
+            if frontier_gids is None:
+                gids = np.full((n_new,), -1, np.int64)
+            else:
+                gids = frontier_gids[base_row + np_parent]
+            rs.log.append(np_packed, gids, np_action)
+        violation = None
+        viol = np.asarray(viol)
+        for i, name in enumerate(self.invariant_names):
+            if int(viol[i]) < n_new:
+                violation = (name, rs.n_total + int(viol[i]))
+                break
+        rs.n_total += n_new
+        rs.n_visited += n_new
+        return n_new, violation, np_packed
+
+    def _rewind_metrics(self, resumed_level: int):
+        """Drop metrics records for levels the resumed run will re-discover
+        (the aborted run may have progressed past the last checkpoint)."""
+        import json
+        import os
+
+        if not self.metrics_path or not os.path.exists(self.metrics_path):
+            return
+        kept = []
+        with open(self.metrics_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("level", 0) <= resumed_level:
+                    kept.append(line)
+        kept.append(json.dumps({"resumed_at_level": resumed_level}) + "\n")
+        with open(self.metrics_path, "w") as f:
+            f.writelines(kept)
+
+    def _emit_metrics(self, rs, level_count):
+        """Structured observability (SURVEY.md §5): one JSONL record per BFS
+        level, mirroring TLC's progress lines (states/sec, queue depth)."""
+        if not self.metrics_path:
+            return
+        import json
+
+        wall = time.time() - rs.t0
+        with open(self.metrics_path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "level": len(rs.level_sizes),
+                        "new_states": level_count,
+                        "distinct_states": rs.n_total,
+                        "frontier": int(level_count),
+                        "wall_s": round(wall, 3),
+                        "states_per_sec": round(rs.n_total / max(wall, 1e-9), 1),
+                        "visited_cap": self._cap,
+                    }
+                )
+                + "\n"
+            )
+
+    def _build_result(
+        self, rs, violation, deadlock_gid=None, deadlock=False, truncated=False
+    ):
+        if self.keep_log:
+            self.last_run_state = rs
+        wall = time.time() - rs.t0
+        res = CheckerResult(
+            distinct_states=rs.n_total,
+            diameter=len(rs.level_sizes),
+            deadlock=deadlock,
+            wall_s=wall,
+            states_per_sec=rs.n_total / max(wall, 1e-9),
+            level_sizes=rs.level_sizes,
+            truncated=truncated,
+        )
+        gid = None
+        if violation is not None:
+            res.violation = violation[0]
+            gid = violation[1]
+        elif deadlock:
+            res.violation = "Deadlock"
+            gid = deadlock_gid
+        if gid is not None:
+            res.trace, res.trace_actions = build_trace(
+                self.model, self._unpack1, gid, rs.log
+            )
+        return res
+
+    def _insert_initial(self, rs) -> Optional[CheckerResult]:
+        """Level 1: enumerate and insert Init states (compaction.tla:188-202).
+
+        Returns a result only on an invariant violation in an initial state.
+        """
+        m = self.model
+        n_init = m.n_initial
+        gen = jax.jit(jax.vmap(lambda i: self.layout.pack(m.gen_initial(i))))
         for start in range(0, n_init, self.F):
             idx = jnp.arange(start, start + self.F, dtype=jnp.int32)
             packed = gen(idx)
             valid = np.arange(start, start + self.F) < n_init
-            vk = self._grow_visited(vk, n_visited + self.F + 1)
+            rs.vk = self._grow_visited(rs.vk, rs.n_visited + self.F + 1)
             out = self._get_step("insert")(
-                packed, jnp.asarray(valid), *vk, jnp.int32(n_visited)
+                packed, jnp.asarray(valid), *rs.vk, jnp.int32(rs.n_visited)
             )
-            vk = out[4:7]
-            n_new, violation = flush_chunk(out, None, 0)
-            insert_new += n_new
-            n_visited += n_new
+            rs.vk = out[4:7]
+            _n_new, violation, _np_new = self._flush_chunk(rs, out, None, 0)
             if violation is not None:
-                level_sizes.append(insert_new)
-                return build_result(violation)
-        level_sizes.append(insert_new)
-        frontier = (
-            np.concatenate(all_packed) if all_packed else np.zeros((0, self.layout.W), np.uint32)
-        )
-        frontier_gids = np.arange(n_total, dtype=np.int64)
+                rs.level_sizes.append(rs.n_total)
+                return self._build_result(rs, violation)
+        rs.level_sizes.append(rs.n_total)
+        rs.frontier = rs.log.packed_matrix()
+        rs.frontier_gids = np.arange(rs.n_total, dtype=np.int64)
+        return None
 
-        # ---- BFS levels ----
-        while len(frontier):
+    def _bfs_loop(self, rs) -> CheckerResult:
+        m = self.model
+        while len(rs.frontier):
             level_new_packed: List[np.ndarray] = []
-            level_base = n_total
+            level_base = rs.n_total
+            frontier, frontier_gids = rs.frontier, rs.frontier_gids
             for start in range(0, len(frontier), self.F):
                 chunk = frontier[start : start + self.F]
                 nc = len(chunk)
@@ -267,47 +414,75 @@ class Checker:
                     chunk = np.concatenate(
                         [chunk, np.zeros((self.F - nc, self.layout.W), np.uint32)]
                     )
-                vk = self._grow_visited(vk, n_visited + self.F * m.A + 1)
-                out = self._get_step("expand")(
-                    jnp.asarray(chunk), jnp.int32(nc), *vk, jnp.int32(n_visited)
+                rs.vk = self._grow_visited(
+                    rs.vk, rs.n_visited + self.F * m.A + 1
                 )
-                vk = out[4:7]
+                out = self._get_step("expand")(
+                    jnp.asarray(chunk), jnp.int32(nc), *rs.vk,
+                    jnp.int32(rs.n_visited),
+                )
+                rs.vk = out[4:7]
                 dead_idx = int(out[8])
-                n_new, violation = flush_chunk(out, frontier_gids, start)
-                n_visited += n_new
+                n_new, violation, np_new = self._flush_chunk(
+                    rs, out, frontier_gids, start
+                )
                 if n_new:
-                    level_new_packed.append(all_packed[-1])
+                    level_new_packed.append(np_new)
                 if violation is not None:
-                    level_sizes.append(n_total - level_base)
-                    return build_result(violation)
+                    rs.level_sizes.append(rs.n_total - level_base)
+                    return self._build_result(rs, violation)
                 if dead_idx < nc:
-                    level_sizes.append(n_total - level_base)
-                    return build_result(
+                    rs.level_sizes.append(rs.n_total - level_base)
+                    return self._build_result(
+                        rs,
                         None,
                         deadlock_gid=int(frontier_gids[start + dead_idx]),
                         deadlock=True,
                     )
-                if n_visited > self.max_states or (
-                    self.time_budget_s is not None
-                    and time.time() - t0 > self.time_budget_s
-                ):
-                    level_sizes.append(n_total - level_base)
-                    return build_result(None, truncated=True)
-            level_count = n_total - level_base
+                if self._over_budget(rs) and self.checkpoint_path is None:
+                    # no checkpoint configured: stop immediately (bench mode)
+                    rs.level_sizes.append(rs.n_total - level_base)
+                    return self._build_result(rs, None, truncated=True)
+            level_count = rs.n_total - level_base
             if level_count == 0:
                 break
-            level_sizes.append(level_count)
-            if self.progress:
-                import sys
+            rs.level_sizes.append(level_count)
+            wall = time.time() - rs.t0
+            self._log(
+                rs,
+                f"level {len(rs.level_sizes)}: +{level_count} "
+                f"(total {rs.n_total}, {rs.n_total/max(wall,1e-9):.0f} st/s)",
+            )
+            self._emit_metrics(rs, level_count)
+            rs.frontier = np.concatenate(level_new_packed)
+            rs.frontier_gids = np.arange(level_base, rs.n_total, dtype=np.int64)
+            over = self._over_budget(rs)
+            if self.checkpoint_path and (
+                over or len(rs.level_sizes) % self.checkpoint_every == 0
+            ):
+                # level boundaries are the consistent snapshot points: the
+                # frontier is exactly the set of unexpanded states
+                self._save_checkpoint(rs)
+            if over:
+                return self._build_result(rs, None, truncated=True)
+        return self._build_result(rs, None)
 
-                wall = time.time() - t0
-                print(
-                    f"  level {len(level_sizes)}: +{level_count} "
-                    f"(total {n_total}, {n_total/max(wall,1e-9):.0f} st/s)",
-                    file=sys.stderr,
-                    flush=True,
-                )
-            frontier = np.concatenate(level_new_packed)
-            frontier_gids = np.arange(level_base, n_total, dtype=np.int64)
+    def _over_budget(self, rs) -> bool:
+        return rs.n_visited > self.max_states or (
+            self.time_budget_s is not None
+            and time.time() - rs.t0 > self.time_budget_s
+        )
 
-        return build_result(None)
+
+class _RunState:
+    """Mutable per-run state of the checker (checkpointable)."""
+
+    def __init__(self):
+        self.t0 = 0.0
+        self.vk = None
+        self.n_visited = 0
+        self.log = None  # MemoryLog | FileLog
+        self.n_total = 0
+        self.level_sizes: List[int] = []
+        self.frontier = None
+        self.frontier_gids = None

@@ -86,25 +86,23 @@ def dedup_core(
     return out_packed, out_parent, out_action, n_new, nvk1, nvk2, nvk3, viol
 
 
-def build_trace(model, unpack1, gid: int, all_packed, all_parent, all_action):
+def build_trace(model, unpack1, gid: int, log):
     """Reconstruct the counterexample behavior ending at global state ``gid``
-    from the host-side (packed, parent, action) log (SURVEY.md §2.2-E7).
+    by walking parent pointers in the state log (SURVEY.md §2.2-E7).
 
     Returns (states as pyeval.State list, action names along the trace).
     """
-    packed = np.concatenate(all_packed)
-    parent = np.concatenate(all_parent)
-    action = np.concatenate(all_action)
     chain = []
     g = gid
     while g >= 0:
         chain.append(g)
-        g = int(parent[g])
+        g = log.get(g)[1]
     chain.reverse()
     states, actions = [], []
     for i, g in enumerate(chain):
-        s = unpack1(jnp.asarray(packed[g]))
+        row, _parent, action = log.get(g)
+        s = unpack1(jnp.asarray(row))
         states.append(model.to_pystate(s))
         if i > 0:
-            actions.append(pyeval.ACTION_NAMES[int(action[g])])
+            actions.append(pyeval.ACTION_NAMES[action])
     return states, actions

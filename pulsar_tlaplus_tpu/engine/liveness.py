@@ -1,0 +1,242 @@
+"""Liveness checking (SURVEY.md §2.2-E10): ``<>goal`` properties over the
+reachable state graph, e.g. ``Termination`` (compaction.tla:303-307).
+
+TPU/host split (SURVEY.md §7-L6): the TPU generates the behavior graph —
+the exhaustive BFS plus one vectorized edge-materialization sweep over all
+discovered states — and the irregular graph analysis (reachability under
+the not-goal restriction, Kahn-peeling cycle detection) runs on the host.
+
+Semantics (matching the oracle, pyeval.check_eventually):
+
+- ``fairness="none"``: ``Spec == Init /\\ [][Next]_vars`` admits infinite
+  stuttering anywhere, so ``<>P`` holds iff every initial state satisfies
+  P; otherwise the counterexample is "stutter forever at a violating
+  initial state" — which is exactly what TLC reports for unfair specs.
+- ``fairness="wf_next"`` (``Spec /\\ WF_vars(Next)``): WF constrains only
+  ``<Next>_vars`` steps — Next steps that *change* the state.  Stuttering
+  disjuncts cannot discharge the fairness obligation, so the property is
+  violated iff some only-not-P path from an initial state reaches a not-P
+  state with no var-changing successor, or a cycle of var-changing not-P
+  transitions (self-loops are stutters by definition and excluded).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pulsar_tlaplus_tpu.engine.bfs import Checker
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+
+
+@dataclass
+class LivenessResult:
+    holds: bool
+    reason: str
+    distinct_states: int
+    # a lasso skeleton when violated under wf_next (state gids)
+    lasso_prefix: Optional[List[int]] = None
+    lasso_cycle: Optional[List[int]] = None
+
+
+class LivenessChecker:
+    """Checks ``<>goal`` for a compiled model's named goal predicate."""
+
+    def __init__(
+        self,
+        model: CompactionModel,
+        goal: str = "Termination",
+        fairness: str = "none",
+        frontier_chunk: int = 2048,
+        visited_cap: int = 1 << 14,
+        max_states: int = 5_000_000,
+    ):
+        if goal != "Termination":
+            raise ValueError(f"unknown liveness property: {goal}")
+        if fairness not in ("none", "wf_next"):
+            raise ValueError(f"unknown fairness: {fairness}")
+        self.model = model
+        self.fairness = fairness
+        self.F = frontier_chunk
+        self._checker = Checker(
+            model,
+            invariants=(),
+            check_deadlock=False,
+            frontier_chunk=frontier_chunk,
+            visited_cap=visited_cap,
+            max_states=max_states,
+            keep_log=True,
+        )
+
+    def run(self) -> LivenessResult:
+        m = self.model
+        layout = m.layout
+        res = self._checker.run()
+        if res.truncated:
+            raise RuntimeError("state space exceeded liveness max_states")
+        rs = self._checker.last_run_state
+        packed = rs.log.packed_matrix()
+        n = len(packed)
+        n_init = rs.level_sizes[0]
+
+        goal_fn = jax.jit(jax.vmap(lambda w: m.termination_goal(layout.unpack(w))))
+        goal = np.zeros((n,), bool)
+        for start in range(0, n, self.F):
+            chunk = packed[start : start + self.F]
+            nc = len(chunk)
+            if nc < self.F:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((self.F - nc, layout.W), np.uint32)]
+                )
+            goal[start : start + nc] = np.asarray(goal_fn(jnp.asarray(chunk)))[:nc]
+
+        if self.fairness == "none":
+            bad = np.nonzero(~goal[:n_init])[0]
+            if len(bad):
+                return LivenessResult(
+                    False,
+                    "stuttering counterexample: initial state "
+                    f"#{int(bad[0])} may stutter forever without reaching "
+                    "the goal (no fairness assumed)",
+                    n,
+                    lasso_prefix=[int(bad[0])],
+                    lasso_cycle=[int(bad[0])],
+                )
+            return LivenessResult(
+                True, "every initial state satisfies the goal", n
+            )
+
+        # ---- wf_next: materialize the edge list (one more device sweep) ----
+        def _one(w):
+            s = layout.unpack(w)
+            succ, valid = m.successors(s)
+            return jax.vmap(layout.pack)(succ), valid
+
+        succ_fn = jax.jit(jax.vmap(_one))
+        gid_of = {packed[i].tobytes(): i for i in range(n)}
+        src_list, dst_list = [], []
+        out_deg = np.zeros((n,), np.int64)
+        for start in range(0, n, self.F):
+            chunk = packed[start : start + self.F]
+            nc = len(chunk)
+            if nc < self.F:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((self.F - nc, layout.W), np.uint32)]
+                )
+            sp, sv = succ_fn(jnp.asarray(chunk))
+            sp = np.asarray(sp)  # [F, A, W]
+            sv = np.asarray(sv)  # [F, A]
+            for i in range(nc):
+                u = start + i
+                for lane in range(m.A):
+                    if sv[i, lane]:
+                        v = gid_of[sp[i, lane].tobytes()]
+                        if v == u:
+                            continue  # stuttering step, not <Next>_vars
+                        src_list.append(u)
+                        dst_list.append(v)
+                        out_deg[u] += 1
+        src = np.asarray(src_list, np.int64)
+        dst = np.asarray(dst_list, np.int64)
+
+        # restrict to not-goal -> not-goal edges; reach R from not-goal inits
+        keep = ~goal[src] & ~goal[dst]
+        rsrc, rdst = src[keep], dst[keep]
+        order_adj = np.argsort(rsrc, kind="stable")
+        rsrc, rdst = rsrc[order_adj], rdst[order_adj]
+        starts = np.searchsorted(rsrc, np.arange(n + 1))
+        in_r = np.zeros((n,), bool)
+        stack = [int(i) for i in np.nonzero(~goal[:n_init])[0]]
+        parent = np.full((n,), -1, np.int64)
+        while stack:
+            u = stack.pop()
+            if in_r[u]:
+                continue
+            in_r[u] = True
+            for v in rdst[starts[u] : starts[u + 1]]:
+                v = int(v)
+                if not in_r[v]:
+                    if parent[v] < 0:
+                        parent[v] = u
+                    stack.append(v)
+        r_nodes = np.nonzero(in_r)[0]
+        if len(r_nodes) == 0:
+            return LivenessResult(
+                True, "all fair behaviors reach the goal", n
+            )
+        dead = r_nodes[out_deg[r_nodes] == 0]
+        if len(dead):
+            g = int(dead[0])
+            return LivenessResult(
+                False,
+                "fair stuttering at a not-goal state with no var-changing "
+                "successor",
+                n,
+                lasso_prefix=self._path_to(parent, g, n_init),
+                lasso_cycle=[g],
+            )
+        # Kahn peel within R
+        indeg = np.zeros((n,), np.int64)
+        both = in_r[rsrc] & in_r[rdst]
+        np.add.at(indeg, rdst[both], 1)
+        queue = [int(u) for u in r_nodes if indeg[u] == 0]
+        alive = in_r.copy()
+        while queue:
+            u = queue.pop()
+            alive[u] = False
+            for v in rdst[starts[u] : starts[u + 1]]:
+                v = int(v)
+                if alive[v]:
+                    indeg[v] -= 1
+                    if indeg[v] == 0:
+                        queue.append(v)
+        cyc_nodes = np.nonzero(alive)[0]
+        if len(cyc_nodes):
+            # Kahn peeling (in-degree) can leave acyclic tail nodes that
+            # dangle off a cycle; peel zero-OUT-degree nodes too so that
+            # every surviving node has an alive successor, making the
+            # cycle-recovery walk total.
+            changed = True
+            while changed:
+                changed = False
+                for u in np.nonzero(alive)[0]:
+                    if not any(
+                        alive[int(v)] for v in rdst[starts[u] : starts[u + 1]]
+                    ):
+                        alive[u] = False
+                        changed = True
+            cyc_nodes = np.nonzero(alive)[0]
+        if len(cyc_nodes):
+            # recover one cycle: walk alive-successors until a repeat
+            u = int(cyc_nodes[0])
+            seen_at = {}
+            walk = []
+            while u not in seen_at:
+                seen_at[u] = len(walk)
+                walk.append(u)
+                nxt = [
+                    int(v)
+                    for v in rdst[starts[u] : starts[u + 1]]
+                    if alive[v]
+                ]
+                u = nxt[0]
+            cycle = walk[seen_at[u] :]
+            return LivenessResult(
+                False,
+                "cycle of not-goal states is fairly traversable",
+                n,
+                lasso_prefix=self._path_to(parent, cycle[0], n_init),
+                lasso_cycle=cycle,
+            )
+        return LivenessResult(True, "all fair behaviors reach the goal", n)
+
+    @staticmethod
+    def _path_to(parent, g, n_init) -> List[int]:
+        path = [g]
+        while path[-1] >= n_init and parent[path[-1]] >= 0:
+            path.append(int(parent[path[-1]]))
+        return list(reversed(path))

@@ -224,9 +224,9 @@ class ShardedChecker:
             jnp.full((nd, self._cap), SENTINEL, jnp.uint32) for _ in range(3)
         )
         n_visited = np.zeros((nd,), np.int64)
-        all_packed: List[np.ndarray] = []
-        all_parent: List[np.ndarray] = []
-        all_action: List[np.ndarray] = []
+        from pulsar_tlaplus_tpu.engine.statelog import MemoryLog
+
+        log = MemoryLog(self.layout.W)
         n_total = 0
         level_sizes: List[int] = []
         # per-shard next-level frontier accumulators (host)
@@ -248,9 +248,11 @@ class ShardedChecker:
                 if nn == 0:
                     continue
                 np_packed = np.asarray(packed[d][:nn])
-                all_packed.append(np_packed)
-                all_parent.append(np.asarray(parent[d][:nn]).astype(np.int64))
-                all_action.append(np.asarray(action[d][:nn]))
+                log.append(
+                    np_packed,
+                    np.asarray(parent[d][:nn]).astype(np.int64),
+                    np.asarray(action[d][:nn]),
+                )
                 next_parts[d].append(np_packed)
                 next_gid_parts[d].append(
                     np.arange(n_total, n_total + nn, dtype=np.int64)
@@ -300,7 +302,7 @@ class ShardedChecker:
                 gid = deadlock_gid
             if gid is not None:
                 res.trace, res.trace_actions = build_trace(
-                    m, self._unpack1, gid, all_packed, all_parent, all_action
+                    m, self._unpack1, gid, log
                 )
             return res
 

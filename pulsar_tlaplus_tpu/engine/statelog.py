@@ -1,0 +1,172 @@
+"""State/trace log backends for the BFS engine (SURVEY.md §2.2-E7/E8).
+
+The engine appends every newly discovered state's ``(packed_row, parent_gid,
+action_id)`` record in global-id order and later reads individual records
+back to reconstruct counterexample traces and to checkpoint.  Two backends:
+
+- :class:`MemoryLog` — numpy chunk list in host RAM (default; fastest).
+- :class:`FileLog` — the native C++ disk store
+  (`pulsar_tlaplus_tpu/native/logstore.cpp`), for runs whose state logs
+  exceed RAM, mirroring TLC's on-disk ``states/`` storage.  Falls back to a
+  pure-python file implementation if the toolchain can't build it.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, Tuple
+
+import numpy as np
+
+
+class MemoryLog:
+    def __init__(self, row_words: int):
+        self.row_words = row_words
+        self._starts: List[int] = []
+        self._packed: List[np.ndarray] = []
+        self._parent: List[np.ndarray] = []
+        self._action: List[np.ndarray] = []
+        self._n = 0
+
+    def append(self, packed: np.ndarray, parent: np.ndarray, action: np.ndarray) -> int:
+        first = self._n
+        self._starts.append(first)
+        self._packed.append(packed)
+        self._parent.append(parent.astype(np.int64))
+        self._action.append(action.astype(np.int32))
+        self._n += len(packed)
+        return first
+
+    def __len__(self) -> int:
+        return self._n
+
+    def get(self, gid: int) -> Tuple[np.ndarray, int, int]:
+        i = bisect.bisect_right(self._starts, gid) - 1
+        off = gid - self._starts[i]
+        return (
+            self._packed[i][off],
+            int(self._parent[i][off]),
+            int(self._action[i][off]),
+        )
+
+    def packed_matrix(self) -> np.ndarray:
+        """All packed rows in gid order (for checkpointing / liveness)."""
+        if not self._packed:
+            return np.zeros((0, self.row_words), np.uint32)
+        return np.concatenate(self._packed)
+
+    def parents(self) -> np.ndarray:
+        return (
+            np.concatenate(self._parent)
+            if self._parent
+            else np.zeros((0,), np.int64)
+        )
+
+    def actions(self) -> np.ndarray:
+        return (
+            np.concatenate(self._action)
+            if self._action
+            else np.zeros((0,), np.int32)
+        )
+
+
+class FileLog:
+    """Disk-backed log; native C++ store when buildable, else pure python."""
+
+    def __init__(self, path: str, row_words: int):
+        self.row_words = row_words
+        self.path = path
+        try:
+            from pulsar_tlaplus_tpu.native import load_logstore
+
+            self._store = load_logstore().LogStore(path, row_words)
+            self.native = True
+        except Exception:
+            self._store = _PyFileStore(path, row_words)
+            self.native = False
+
+    def append(self, packed: np.ndarray, parent: np.ndarray, action: np.ndarray) -> int:
+        packed = np.ascontiguousarray(packed, np.uint32)
+        parent = np.ascontiguousarray(parent, np.int64)
+        action = np.ascontiguousarray(action, np.int32)
+        return self._store.append(
+            packed.tobytes(), parent.tobytes(), action.tobytes(), len(packed)
+        )
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def get(self, gid: int) -> Tuple[np.ndarray, int, int]:
+        row_bytes, parent, action = self._store.get(gid)
+        return (
+            np.frombuffer(row_bytes, np.uint32).copy(),
+            int(parent),
+            int(action),
+        )
+
+    def packed_matrix(self) -> np.ndarray:
+        out = np.zeros((len(self), self.row_words), np.uint32)
+        for g in range(len(self)):
+            out[g] = self.get(g)[0]
+        return out
+
+    def sync(self):
+        if hasattr(self._store, "sync"):
+            self._store.sync()
+
+    def truncate(self, n: int):
+        """Drop records past ``n`` (checkpoint resume discards any records
+        appended after the last durable snapshot)."""
+        if n > len(self):
+            raise ValueError("cannot truncate forward")
+        if n == len(self):
+            return
+        import os
+
+        rec = self.row_words * 4 + 12
+        self.sync()
+        # reopen fresh after truncating the backing file
+        os.truncate(self.path, n * rec)
+        self.__init__(self.path, self.row_words)
+
+
+class _PyFileStore:
+    """Pure-python fallback with the native store's exact record format."""
+
+    def __init__(self, path: str, row_words: int):
+        self.rec = row_words * 4 + 12
+        self.row_words = row_words
+        self._f = open(path, "a+b")
+        self._f.seek(0, 2)
+        if self._f.tell() % self.rec:
+            raise ValueError("existing file size is not a whole number of records")
+        self._n = self._f.tell() // self.rec
+
+    def append(self, packed: bytes, parents: bytes, actions: bytes, n: int) -> int:
+        rw4 = self.row_words * 4
+        first = self._n
+        chunks = []
+        for i in range(n):
+            chunks.append(packed[i * rw4 : (i + 1) * rw4])
+            chunks.append(parents[i * 8 : (i + 1) * 8])
+            chunks.append(actions[i * 4 : (i + 1) * 4])
+        self._f.seek(0, 2)
+        self._f.write(b"".join(chunks))
+        self._n += n
+        return first
+
+    def __len__(self) -> int:
+        return self._n
+
+    def get(self, gid: int):
+        import struct
+
+        self._f.flush()
+        self._f.seek(gid * self.rec)
+        buf = self._f.read(self.rec)
+        rw4 = self.row_words * 4
+        parent, action = struct.unpack_from("<qi", buf, rw4)
+        return buf[:rw4], parent, action
+
+    def sync(self):
+        self._f.flush()

@@ -270,7 +270,14 @@ class CompactionModel:
         self-loop (compaction.tla:205-214).
         """
         consumer = jnp.bool_(self.c.model_consumer)
-        terminating = (
+        return consumer | self.termination_goal(s)
+
+    def termination_goal(self, s: SState) -> jax.Array:
+        """The body of the Termination liveness property
+        (compaction.tla:303-307): producer done, compactor parked in
+        PhaseTwoWrite with all ledger slots used, consumer done.  (Same
+        condition as the Terminating guard, compaction.tla:205-214.)"""
+        return (
             (s.length == self.M)
             & (s.cstate == pyeval.PHASE_TWO_WRITE)
             & (self._max_led_id(s.led_present) == self.C)
@@ -279,7 +286,6 @@ class CompactionModel:
                 | (s.consume == self.c.consume_times_limit)
             )
         )
-        return consumer | terminating
 
     # ------------------------------------------------------------------
     # invariants (compaction.tla:236-294); True = satisfied

@@ -1,0 +1,62 @@
+"""Native runtime components (C++ CPython extensions).
+
+``build()`` compiles ``logstore.cpp`` with the system toolchain directly
+(g++; no pybind11 in the image) into this package directory.  Import of
+``_logstore`` triggers a build on first use; failures fall back to the
+pure-python implementation in ``engine/statelog.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(__file__)
+
+
+def _ext_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, "_logstore" + suffix)
+
+
+def build(force: bool = False) -> str:
+    """Compile the extension if needed; returns the .so path."""
+    out = _ext_path()
+    src = os.path.join(_DIR, "logstore.cpp")
+    if not force and os.path.exists(out) and os.path.getmtime(
+        out
+    ) >= os.path.getmtime(src):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        f"-I{include}",
+        src,
+        "-o",
+        out,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
+def load_logstore():
+    """Returns the native _logstore module, building it if necessary.
+
+    Raises on toolchain/build failure — callers fall back to the python
+    implementation.
+    """
+    try:
+        from pulsar_tlaplus_tpu.native import _logstore  # type: ignore
+
+        return _logstore
+    except ImportError:
+        build()
+        import importlib
+
+        return importlib.import_module("pulsar_tlaplus_tpu.native._logstore")

@@ -1,0 +1,237 @@
+// Native disk-backed state/trace log for the BFS engine.
+//
+// TLC keeps discovered states and their parent-fingerprint chains on disk
+// (the gitignored `states/` dir, reference .gitignore:2) so traces can be
+// reconstructed without holding every state in RAM.  This is the TPU
+// framework's native equivalent (SURVEY.md §2.2-E7/E8): an append-only
+// fixed-record file
+//
+//     record := packed_state(u32 x row_words) | parent_gid(i64) | action(i32)
+//
+// written with pwrite/pread so appends (BFS flush) and random reads (trace
+// walk, checkpoint resume) can interleave without seek bookkeeping.  At
+// 10^9 states this is ~100 GB — far beyond host RAM — while the BFS hot
+// path only ever touches the (device-resident) fingerprint set.
+//
+// Built as a CPython extension (no pybind11 in the image); a pure-python
+// fallback with the same API lives in pulsar_tlaplus_tpu/engine/statelog.py.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct LogStoreObject {
+    PyObject_HEAD
+    int fd;
+    Py_ssize_t row_words;
+    Py_ssize_t rec_size;
+    Py_ssize_t n_rows;
+};
+
+int logstore_init(LogStoreObject* self, PyObject* args, PyObject* kwds) {
+    const char* path = nullptr;
+    Py_ssize_t row_words = 0;
+    static const char* kwlist[] = {"path", "row_words", nullptr};
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "sn",
+                                     const_cast<char**>(kwlist), &path,
+                                     &row_words)) {
+        return -1;
+    }
+    if (row_words <= 0 || row_words > (1 << 16)) {
+        PyErr_SetString(PyExc_ValueError, "row_words out of range");
+        return -1;
+    }
+    self->fd = ::open(path, O_RDWR | O_CREAT, 0644);
+    if (self->fd < 0) {
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+        return -1;
+    }
+    self->row_words = row_words;
+    self->rec_size = row_words * 4 + 8 + 4;
+    off_t end = ::lseek(self->fd, 0, SEEK_END);
+    if (end < 0 || end % self->rec_size != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "existing file size is not a whole number of records");
+        ::close(self->fd);
+        self->fd = -1;
+        return -1;
+    }
+    self->n_rows = end / self->rec_size;
+    return 0;
+}
+
+void logstore_dealloc(LogStoreObject* self) {
+    if (self->fd >= 0) {
+        ::close(self->fd);
+    }
+    Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+// append(packed_bytes, parents_bytes, actions_bytes, n) -> first_gid
+PyObject* logstore_append(LogStoreObject* self, PyObject* args) {
+    Py_buffer packed, parents, actions;
+    Py_ssize_t n = 0;
+    if (!PyArg_ParseTuple(args, "y*y*y*n", &packed, &parents, &actions, &n)) {
+        return nullptr;
+    }
+    PyObject* result = nullptr;
+    if (packed.len != n * self->row_words * 4 || parents.len != n * 8 ||
+        actions.len != n * 4) {
+        PyErr_SetString(PyExc_ValueError, "buffer sizes do not match n");
+        goto done;
+    }
+    {
+        // interleave into one write buffer per batch
+        Py_ssize_t total = n * self->rec_size;
+        char* buf = static_cast<char*>(PyMem_Malloc(total));
+        if (!buf) {
+            PyErr_NoMemory();
+            goto done;
+        }
+        const char* p = static_cast<const char*>(packed.buf);
+        const char* q = static_cast<const char*>(parents.buf);
+        const char* a = static_cast<const char*>(actions.buf);
+        const Py_ssize_t rw4 = self->row_words * 4;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            char* dst = buf + i * self->rec_size;
+            std::memcpy(dst, p + i * rw4, rw4);
+            std::memcpy(dst + rw4, q + i * 8, 8);
+            std::memcpy(dst + rw4 + 8, a + i * 4, 4);
+        }
+        off_t off = static_cast<off_t>(self->n_rows) * self->rec_size;
+        Py_ssize_t written = 0;
+        while (written < total) {
+            ssize_t w = ::pwrite(self->fd, buf + written, total - written,
+                                 off + written);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                PyMem_Free(buf);
+                PyErr_SetFromErrno(PyExc_OSError);
+                goto done;
+            }
+            written += w;
+        }
+        PyMem_Free(buf);
+        Py_ssize_t first = self->n_rows;
+        self->n_rows += n;
+        result = PyLong_FromSsize_t(first);
+    }
+done:
+    PyBuffer_Release(&packed);
+    PyBuffer_Release(&parents);
+    PyBuffer_Release(&actions);
+    return result;
+}
+
+// get(gid) -> (packed_bytes, parent, action)
+PyObject* logstore_get(LogStoreObject* self, PyObject* args) {
+    Py_ssize_t gid = 0;
+    if (!PyArg_ParseTuple(args, "n", &gid)) {
+        return nullptr;
+    }
+    if (gid < 0 || gid >= self->n_rows) {
+        PyErr_SetString(PyExc_IndexError, "gid out of range");
+        return nullptr;
+    }
+    char rec[1 << 12];
+    char* buf = rec;
+    PyObject* result = nullptr;
+    if (self->rec_size > static_cast<Py_ssize_t>(sizeof(rec))) {
+        buf = static_cast<char*>(PyMem_Malloc(self->rec_size));
+        if (!buf) return PyErr_NoMemory();
+    }
+    off_t off = static_cast<off_t>(gid) * self->rec_size;
+    Py_ssize_t done_n = 0;
+    while (done_n < self->rec_size) {
+        ssize_t r =
+            ::pread(self->fd, buf + done_n, self->rec_size - done_n, off + done_n);
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            PyErr_SetFromErrno(PyExc_OSError);
+            goto done;
+        }
+        if (r == 0) {
+            PyErr_SetString(PyExc_EOFError, "short read");
+            goto done;
+        }
+        done_n += r;
+    }
+    {
+        const Py_ssize_t rw4 = self->row_words * 4;
+        int64_t parent;
+        int32_t action;
+        std::memcpy(&parent, buf + rw4, 8);
+        std::memcpy(&action, buf + rw4 + 8, 4);
+        result = Py_BuildValue("y#Li", buf, rw4, (long long)parent,
+                               (int)action);
+    }
+done:
+    if (buf != rec) PyMem_Free(buf);
+    return result;
+}
+
+PyObject* logstore_sync(LogStoreObject* self, PyObject*) {
+    if (::fsync(self->fd) < 0) {
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    Py_RETURN_NONE;
+}
+
+Py_ssize_t logstore_len(PyObject* self) {
+    return reinterpret_cast<LogStoreObject*>(self)->n_rows;
+}
+
+PyMethodDef logstore_methods[] = {
+    {"append", reinterpret_cast<PyCFunction>(logstore_append), METH_VARARGS,
+     "append(packed_bytes, parents_bytes, actions_bytes, n) -> first gid"},
+    {"get", reinterpret_cast<PyCFunction>(logstore_get), METH_VARARGS,
+     "get(gid) -> (packed_bytes, parent, action)"},
+    {"sync", reinterpret_cast<PyCFunction>(logstore_sync), METH_NOARGS,
+     "fsync the backing file"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PySequenceMethods logstore_as_sequence = {
+    logstore_len, /* sq_length */
+};
+
+PyTypeObject LogStoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+PyModuleDef logstore_module = {
+    PyModuleDef_HEAD_INIT, "_logstore",
+    "Disk-backed fixed-record state/trace log (native)", -1, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__logstore(void) {
+    LogStoreType.tp_name = "_logstore.LogStore";
+    LogStoreType.tp_basicsize = sizeof(LogStoreObject);
+    LogStoreType.tp_flags = Py_TPFLAGS_DEFAULT;
+    LogStoreType.tp_new = PyType_GenericNew;
+    LogStoreType.tp_init = reinterpret_cast<initproc>(logstore_init);
+    LogStoreType.tp_dealloc = reinterpret_cast<destructor>(logstore_dealloc);
+    LogStoreType.tp_methods = logstore_methods;
+    LogStoreType.tp_as_sequence = &logstore_as_sequence;
+    if (PyType_Ready(&LogStoreType) < 0) return nullptr;
+    PyObject* mod = PyModule_Create(&logstore_module);
+    if (!mod) return nullptr;
+    Py_INCREF(&LogStoreType);
+    if (PyModule_AddObject(mod, "LogStore",
+                           reinterpret_cast<PyObject*>(&LogStoreType)) < 0) {
+        Py_DECREF(&LogStoreType);
+        Py_DECREF(mod);
+        return nullptr;
+    }
+    return mod;
+}

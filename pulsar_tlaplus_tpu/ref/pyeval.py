@@ -258,13 +258,9 @@ def successors(c: Constants, s: State) -> Iterator[tuple[int, State]]:
     if c.model_consumer:
         yield 8, s
 
-    # Terminating self-loop (compaction.tla:205-214).
-    if (
-        n == c.message_sent_limit
-        and s.cstate == PHASE_TWO_WRITE
-        and _max_ledger_id(s.ledgers) == c.compaction_times_limit
-        and ((not c.model_consumer) or s.consume == c.consume_times_limit)
-    ):
+    # Terminating self-loop (compaction.tla:205-214).  Its guard is the
+    # same condition as the Termination property body (compaction.tla:303-307).
+    if termination_goal(c, s):
         yield 9, s
 
 
@@ -371,6 +367,118 @@ INVARIANTS = {
     "CompactionHorizonCorrectness": compaction_horizon_correctness,
     "DuplicateNullKeyMessage": duplicate_null_key_message,
 }
+
+
+# ---------------------------------------------------------------------------
+# Liveness (compaction.tla:303-307)
+# ---------------------------------------------------------------------------
+
+
+def termination_goal(c: Constants, s: State) -> bool:
+    """Body of the Termination property ``<>(...)`` (compaction.tla:303-307)."""
+    return (
+        len(s.messages) == c.message_sent_limit
+        and s.cstate == PHASE_TWO_WRITE
+        and _max_ledger_id(s.ledgers) == c.compaction_times_limit
+        and ((not c.model_consumer) or s.consume == c.consume_times_limit)
+    )
+
+
+def check_eventually(c: Constants, fairness: str = "none"):
+    """Oracle liveness check of ``<>goal`` over ``Spec == Init /\\ [][Next]_vars``.
+
+    fairness="none": the raw spec admits infinite stuttering anywhere, so
+    ``<>P`` holds iff every *initial* state satisfies P (otherwise: stutter
+    at a violating initial state forever).
+
+    fairness="wf_next" (i.e. Spec /\\ WF_vars(Next)): WF constrains only
+    ``<Next>_vars`` steps — Next steps that *change* vars.  Stuttering
+    disjuncts (Consumer, Terminating) are not ``<Next>_vars`` steps and
+    cannot discharge the fairness obligation, so a fair behavior may
+    stutter forever only where no var-changing Next step is enabled.
+    ``<>P`` is violated iff some path from an initial state through
+    only-not-P states reaches (a) a state with no var-changing successor,
+    or (b) a cycle (of var-changing transitions; self-loops are by
+    definition stutters and excluded) of not-P states.
+
+    Returns (holds: bool, reason: str).
+    """
+    seen = {}
+    order = []
+    frontier = []
+    for s in initial_states(c):
+        if s not in seen:
+            seen[s] = len(order)
+            order.append(s)
+            frontier.append(s)
+    n_init = len(order)
+    edges = []
+    i = 0
+    while i < len(order):
+        s = order[i]
+        for _a, t in successors(c, s):
+            if t not in seen:
+                seen[t] = len(order)
+                order.append(t)
+            if t != s:  # <Next>_vars steps only; self-loops are stutters
+                edges.append((seen[s], seen[t]))
+        i += 1
+    goal = [termination_goal(c, s) for s in order]
+
+    if fairness == "none":
+        bad = [i for i in range(n_init) if not goal[i]]
+        if bad:
+            return False, (
+                "stuttering counterexample: initial state may stutter "
+                "forever without reaching the goal (no fairness assumed)"
+            )
+        return True, "every initial state satisfies the goal"
+
+    if fairness != "wf_next":
+        raise ValueError(f"unknown fairness: {fairness}")
+    # restrict to not-goal states reachable from not-goal inits via
+    # not-goal-only paths
+    adj = {}
+    out_deg = [0] * len(order)
+    for u, v in edges:
+        out_deg[u] += 1
+        if not goal[u] and not goal[v]:
+            adj.setdefault(u, []).append(v)
+    r = set()
+    stack = [i for i in range(n_init) if not goal[i]]
+    while stack:
+        u = stack.pop()
+        if u in r:
+            continue
+        r.add(u)
+        for v in adj.get(u, ()):
+            if v not in r:
+                stack.append(v)
+    for u in r:
+        if out_deg[u] == 0:
+            return False, (
+                "fair stuttering at a not-goal state with no var-changing "
+                "successor"
+            )
+    # cycle detection within R via Kahn's algorithm
+    indeg = {u: 0 for u in r}
+    for u in r:
+        for v in adj.get(u, ()):
+            if v in r:
+                indeg[v] += 1
+    queue = [u for u in r if indeg[u] == 0]
+    removed = 0
+    while queue:
+        u = queue.pop()
+        removed += 1
+        for v in adj.get(u, ()):
+            if v in r:
+                indeg[v] -= 1
+                if indeg[v] == 0:
+                    queue.append(v)
+    if removed < len(r):
+        return False, "cycle of not-goal states is fairly traversable"
+    return True, "all fair behaviors reach the goal"
 
 DEFAULT_INVARIANTS = ("TypeSafe", "CompactionHorizonCorrectness")  # compaction.cfg:25-31
 

@@ -1,0 +1,58 @@
+"""Checkpoint/resume tests (SURVEY.md §2.2-E8): a truncated run must resume
+to the exact published state count, and traces must span checkpoints."""
+
+import dataclasses
+
+import pytest
+
+from pulsar_tlaplus_tpu.engine.bfs import Checker
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+from tests.helpers import assert_valid_counterexample
+
+
+def test_checkpoint_resume_exact_count(tmp_path):
+    m = CompactionModel(pe.SHIPPED_CFG)
+    path = str(tmp_path / "ck.npz")
+    r1 = Checker(
+        m, visited_cap=1 << 16, checkpoint_path=path,
+        checkpoint_every=3, max_states=10_000,
+    ).run()
+    assert r1.truncated and r1.distinct_states < 45198
+    r2 = Checker(m, visited_cap=1 << 16, checkpoint_path=path).run(resume=True)
+    assert r2.distinct_states == 45198
+    assert r2.diameter == 20
+    assert not r2.truncated
+
+
+def test_checkpoint_config_mismatch_rejected(tmp_path):
+    m = CompactionModel(pe.SHIPPED_CFG)
+    path = str(tmp_path / "ck.npz")
+    Checker(
+        m, visited_cap=1 << 16, checkpoint_path=path,
+        checkpoint_every=2, max_states=5_000,
+    ).run()
+    other = CompactionModel(
+        dataclasses.replace(pe.SHIPPED_CFG, max_crash_times=2)
+    )
+    with pytest.raises(ValueError, match="different model configuration"):
+        Checker(other, checkpoint_path=path).run(resume=True)
+
+
+def test_trace_spans_checkpoint(tmp_path):
+    m = CompactionModel(pe.SHIPPED_CFG)
+    path = str(tmp_path / "ck.npz")
+    inv = ("CompactedLedgerLeak",)
+    r1 = Checker(
+        m, invariants=inv, visited_cap=1 << 16, checkpoint_path=path,
+        checkpoint_every=2, max_states=8_000,
+    ).run()
+    assert r1.truncated and r1.violation is None
+    r2 = Checker(m, invariants=inv, visited_cap=1 << 16, checkpoint_path=path).run(
+        resume=True
+    )
+    assert r2.violation == "CompactedLedgerLeak"
+    assert r2.diameter == 12
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r2.trace, r2.trace_actions, "CompactedLedgerLeak"
+    )
