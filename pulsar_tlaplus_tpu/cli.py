@@ -135,10 +135,11 @@ def main(argv=None):
                 goal=args.liveness_property,
                 fairness=args.fairness,
                 frontier_chunk=args.chunk,
+                max_states=args.maxstates,
             )
-        except ValueError as e:
+            lres = lck.run()
+        except (ValueError, RuntimeError) as e:
             sys.exit(f"tpu-tlc: {e}")
-        lres = lck.run()
         verdict = "satisfied" if lres.holds else "VIOLATED"
         print(
             f"Temporal property {args.liveness_property} "

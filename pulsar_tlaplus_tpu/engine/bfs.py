@@ -213,6 +213,7 @@ class Checker:
             level_sizes=np.asarray(rs.level_sizes, np.int64),
             frontier=rs.frontier,
             frontier_gids=rs.frontier_gids,
+            wall_s=np.float64(time.time() - rs.t0),
             **log_arrays,
         )
         import os
@@ -234,6 +235,10 @@ class Checker:
         rs.t0 = time.time()
         if resume:
             d = self.load_checkpoint()
+            if "wall_s" in d:
+                # carry cumulative wall time across resume so wall_s /
+                # states_per_sec stay meaningful for the whole run
+                rs.t0 = time.time() - float(d["wall_s"])
             self._cap = len(d["vk0"])
             rs.vk = tuple(jnp.asarray(d[k]) for k in ("vk0", "vk1", "vk2"))
             rs.n_visited = int(d["n_visited"])
@@ -262,7 +267,7 @@ class Checker:
             jnp.full((self._cap,), SENTINEL, jnp.uint32) for _ in range(3)
         )
         rs.log = (
-            FileLog(self.state_log_path, self.layout.W)
+            FileLog(self.state_log_path, self.layout.W, fresh=True)
             if self.state_log_path
             else MemoryLog(self.layout.W)
         )
@@ -325,7 +330,9 @@ class Checker:
 
     def _emit_metrics(self, rs, level_count):
         """Structured observability (SURVEY.md §5): one JSONL record per BFS
-        level, mirroring TLC's progress lines (states/sec, queue depth)."""
+        level, mirroring TLC's progress lines (states/sec, queue depth).
+        ``frontier`` is the queue depth at level start (states expanded);
+        ``new_states`` is the discovery count (= next level's depth)."""
         if not self.metrics_path:
             return
         import json
@@ -338,7 +345,7 @@ class Checker:
                         "level": len(rs.level_sizes),
                         "new_states": level_count,
                         "distinct_states": rs.n_total,
-                        "frontier": int(level_count),
+                        "frontier": int(len(rs.frontier)),  # pre-swap: expanded
                         "wall_s": round(wall, 3),
                         "states_per_sec": round(rs.n_total / max(wall, 1e-9), 1),
                         "visited_cap": self._cap,

@@ -71,11 +71,20 @@ class MemoryLog:
 
 
 class FileLog:
-    """Disk-backed log; native C++ store when buildable, else pure python."""
+    """Disk-backed log; native C++ store when buildable, else pure python.
 
-    def __init__(self, path: str, row_words: int):
+    ``fresh=True`` truncates any pre-existing file at `path` — a fresh
+    (non-resume) run must not append after stale records, or gid/log-row
+    alignment breaks and traces read garbage.
+    """
+
+    def __init__(self, path: str, row_words: int, fresh: bool = False):
+        import os
+
         self.row_words = row_words
         self.path = path
+        if fresh and os.path.exists(path):
+            os.truncate(path, 0)
         try:
             from pulsar_tlaplus_tpu.native import load_logstore
 
@@ -84,6 +93,11 @@ class FileLog:
         except Exception:
             self._store = _PyFileStore(path, row_words)
             self.native = False
+
+    def close(self):
+        if hasattr(self._store, "close"):
+            self._store.close()
+        self._store = None
 
     def append(self, packed: np.ndarray, parent: np.ndarray, action: np.ndarray) -> int:
         packed = np.ascontiguousarray(packed, np.uint32)
@@ -125,7 +139,8 @@ class FileLog:
 
         rec = self.row_words * 4 + 12
         self.sync()
-        # reopen fresh after truncating the backing file
+        # close the old store, truncate the backing file, reopen
+        self.close()
         os.truncate(self.path, n * rec)
         self.__init__(self.path, self.row_words)
 
@@ -141,6 +156,9 @@ class _PyFileStore:
         if self._f.tell() % self.rec:
             raise ValueError("existing file size is not a whole number of records")
         self._n = self._f.tell() // self.rec
+
+    def close(self):
+        self._f.close()
 
     def append(self, packed: bytes, parents: bytes, actions: bytes, n: int) -> int:
         rw4 = self.row_words * 4

@@ -1,0 +1,8 @@
+"""TLA+ spec front end (SURVEY.md §2.2-E1).
+
+Pipeline: lexer -> parser (column-aware, TLA+ junction lists) -> AST ->
+  * generic structural interpreter (host; the universal semantic oracle), and
+  * finite-domain type inference -> packed layout -> JAX kernel codegen
+    (the TPU path), producing models with the same interface as the
+    hand-compiled ones in :mod:`pulsar_tlaplus_tpu.models`.
+"""

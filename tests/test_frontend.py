@@ -1,0 +1,301 @@
+"""Front-end tests: parser structure, interpreter semantics, and full
+state-set differential vs the hand-written pyeval oracle on the
+compaction spec (SURVEY.md §4a/§4d; reference /root/reference/compaction.tla).
+"""
+
+import pytest
+
+from pulsar_tlaplus_tpu.frontend import interp as I
+from pulsar_tlaplus_tpu.frontend import tla_ast as A
+from pulsar_tlaplus_tpu.frontend.loader import (
+    compaction_constants,
+    compaction_pystate,
+)
+from pulsar_tlaplus_tpu.frontend.parser import parse_file, parse_module
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+REFERENCE_TLA = "/root/reference/compaction.tla"
+
+
+@pytest.fixture(scope="module")
+def module():
+    return parse_file(REFERENCE_TLA)
+
+
+def spec_for(module, c: pe.Constants) -> I.Spec:
+    return I.Spec(module, compaction_constants(c))
+
+
+def pyeval_bfs(c: pe.Constants):
+    seen = set()
+    frontier = list(pe.initial_states(c))
+    seen.update(frontier)
+    diam = 0
+    while frontier:
+        new = []
+        for s in frontier:
+            for _a, t in pe.successors(c, s):
+                if t not in seen:
+                    seen.add(t)
+                    new.append(t)
+        frontier = new
+        if frontier:
+            diam += 1
+    return seen, diam
+
+
+# --------------------------------------------------------------------------
+# parser
+# --------------------------------------------------------------------------
+
+
+class TestParser:
+    def test_reference_module_structure(self, module):
+        assert module.name == "compaction"
+        assert "Sequences" in module.extends
+        assert len(module.constants) == 16  # 9 params + 7 model values
+        assert module.variables == (
+            "messages",
+            "compactedLedgers",
+            "cursor",
+            "compactorState",
+            "phaseOneResult",
+            "compactionHorizon",
+            "compactedTopicContext",
+            "crashTimes",
+            "consumeTimes",
+        )
+        names = [d.name for d in module.defs]
+        for required in (
+            "Init",
+            "Next",
+            "Spec",
+            "TypeSafe",
+            "CompactionHorizonCorrectness",
+            "CompactedLedgerLeak",
+            "DuplicateNullKeyMessage",
+            "Termination",
+        ):
+            assert required in names
+
+    def test_junction_alignment(self):
+        m = parse_module(
+            """---- MODULE t ----
+X ==
+    /\\ 1 = 1
+    /\\ \\/ 2 = 2
+       \\/ 3 = 3
+    /\\ 4 = 4
+====
+"""
+        )
+        x = m.defs_by_name()["X"].body
+        assert isinstance(x, A.Junction) and x.op == "/\\"
+        assert len(x.items) == 3
+        assert isinstance(x.items[1], A.Junction) and x.items[1].op == "\\/"
+
+    def test_misaligned_bullets_become_infix(self):
+        # the reference's BrokerCrash THEN-branch layout (compaction.tla:177-178)
+        m = parse_module(
+            """---- MODULE t ----
+X == IF TRUE
+     THEN /\\ 1 = 1
+           /\\ 2 = 2
+     ELSE FALSE
+====
+"""
+        )
+        x = m.defs_by_name()["X"].body
+        assert isinstance(x, A.If)
+
+    def test_precedence(self):
+        m = parse_module(
+            """---- MODULE t ----
+X == 1 + 2 * 3 = 7 /\\ 2 >= 1
+Y == {i \\in 1..4 : i % 2 = 0}
+Z == [k \\in {1, 2} |-> k + 1]
+====
+"""
+        )
+        s = I.Spec(m, {})
+        assert s.genv.lookup("X") is True
+        assert s.genv.lookup("Y") == frozenset({2, 4})
+        assert s.genv.lookup("Z") == (2, 3)
+
+    def test_temporal_forms_parse(self, module):
+        spec_def = module.defs_by_name()["Spec"]
+        term = module.defs_by_name()["Termination"]
+        assert isinstance(term.body, A.UnOp) and term.body.op == "<>"
+        # Spec == Init /\ [][Next]_vars
+        assert isinstance(spec_def.body, A.BinOp)
+
+
+# --------------------------------------------------------------------------
+# interpreter semantics
+# --------------------------------------------------------------------------
+
+
+class TestInterp:
+    def test_value_canonicalization(self):
+        # functions over 1..n normalize to tuples (sequence equality)
+        assert I.make_fn({1: "a", 2: "b"}) == ("a", "b")
+        assert I.make_fn({}) == ()
+        f = I.make_fn({2: 10, 5: 20})
+        assert isinstance(f, I.FDict)
+        assert f[5] == 20
+
+    def test_assume_checks(self, module):
+        c = pe.Constants(
+            message_sent_limit=1,
+            compaction_times_limit=1,
+            num_keys=1,
+            num_values=1,
+        )
+        spec_for(module, c).check_assumes()  # must not raise
+
+    def test_lazy_let_out_of_domain(self, module):
+        """CompactionHorizonCorrectness at horizon=0 must not force
+        compactedLedgers[0] (TLC lazy-LET parity, SURVEY.md C23)."""
+        c = pe.Constants(
+            message_sent_limit=1,
+            compaction_times_limit=1,
+            num_keys=1,
+            num_values=1,
+            model_producer=True,
+        )
+        spec = spec_for(module, c)
+        s0 = spec.initial_states()[0]
+        assert spec.eval_predicate("CompactionHorizonCorrectness", s0)
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(
+                message_sent_limit=2,
+                compaction_times_limit=2,
+                num_keys=1,
+                num_values=1,
+                max_crash_times=1,
+                model_producer=True,
+            ),
+            dict(
+                message_sent_limit=2,
+                compaction_times_limit=2,
+                num_keys=2,
+                num_values=1,
+                max_crash_times=1,
+                model_producer=False,
+            ),
+            dict(
+                message_sent_limit=2,
+                compaction_times_limit=2,
+                num_keys=1,
+                num_values=2,
+                max_crash_times=1,
+                model_producer=True,
+                retain_null_key=False,
+                model_consumer=True,
+            ),
+        ],
+    )
+    def test_state_set_matches_pyeval(self, module, kw):
+        """The full reachable state SET (not just the count) matches the
+        hand-written oracle."""
+        c = pe.Constants(**kw)
+        spec = spec_for(module, c)
+        r = I.bfs_check(spec, check_deadlock=False)
+        ref_seen, ref_diam = pyeval_bfs(c)
+
+        seen = set()
+        frontier = spec.initial_states()
+        seen.update(frontier)
+        while frontier:
+            new = []
+            for s in frontier:
+                for _lab, t in spec.successors(s):
+                    if t not in seen:
+                        seen.add(t)
+                        new.append(t)
+            frontier = new
+        got = {compaction_pystate(s) for s in seen}
+        assert got == ref_seen
+        assert r.distinct_states == len(ref_seen)
+        assert r.diameter == ref_diam
+
+    def test_action_labels(self, module):
+        c = pe.Constants(
+            message_sent_limit=2,
+            compaction_times_limit=1,
+            num_keys=1,
+            num_values=1,
+            model_producer=True,
+        )
+        spec = spec_for(module, c)
+        s0 = spec.initial_states()[0]
+        labels = {lab for lab, _t in spec.successors(s0)}
+        assert "Producer" in labels
+        assert "BrokerCrash" in labels
+
+
+# --------------------------------------------------------------------------
+# oracle parity on the shipped configuration
+# --------------------------------------------------------------------------
+
+
+class TestOracles:
+    def test_shipped_cfg_state_count(self, module):
+        """45,198 distinct states — the spec's own ground truth
+        (compaction.tla:23)."""
+        spec = spec_for(module, pe.SHIPPED_CFG)
+        r = I.bfs_check(
+            spec,
+            invariants=("TypeSafe", "CompactionHorizonCorrectness"),
+            check_deadlock=False,
+        )
+        assert r.violation is None
+        assert r.distinct_states == 45198
+
+    @pytest.mark.parametrize(
+        "inv,kw,max_depth",
+        [
+            (
+                "CompactedLedgerLeak",
+                dict(
+                    message_sent_limit=2,
+                    compaction_times_limit=3,
+                    num_keys=1,
+                    num_values=1,
+                    max_crash_times=1,
+                    model_producer=True,
+                ),
+                12,
+            ),
+            (
+                "DuplicateNullKeyMessage",
+                dict(
+                    message_sent_limit=2,
+                    compaction_times_limit=2,
+                    num_keys=1,
+                    num_values=1,
+                    max_crash_times=1,
+                    model_producer=False,
+                ),
+                3,
+            ),
+        ],
+    )
+    def test_bug_invariants_violate(self, module, inv, kw, max_depth):
+        """The two known, unfixed Pulsar bugs reproduce as counterexamples
+        (compaction.tla:252,279), with a valid shortest trace."""
+        c = pe.Constants(**kw)
+        spec = spec_for(module, c)
+        r = I.bfs_check(spec, invariants=(inv,), check_deadlock=False)
+        assert r.violation == inv
+        assert len(r.trace) - 1 <= max_depth
+        # trace validity: starts initial, consecutive, ends in violation
+        assert r.trace[0] in spec.initial_states()
+        for a, b in zip(r.trace, r.trace[1:]):
+            assert any(t == b for _lab, t in spec.successors(a))
+        assert not spec.eval_predicate(inv, r.trace[-1])
+        for s in r.trace[:-1]:
+            assert spec.eval_predicate(inv, s)
