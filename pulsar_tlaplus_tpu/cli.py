@@ -24,6 +24,84 @@ def _positive_or_tpu(v: str):
     return v if v == "tpu" else int(v)
 
 
+def _report(r, constants, wall: float) -> int:
+    """TLC-style result report shared by the compiled and interpreter
+    paths; returns the process exit code (0 ok, 1 violation/deadlock,
+    3 truncated — a truncated search is NOT a verification result)."""
+    from pulsar_tlaplus_tpu.utils.render import render_trace
+
+    if r.violation and r.violation != "Deadlock":
+        print(f"Error: Invariant {r.violation} is violated.")
+        print("The behavior up to this point is:")
+        print(render_trace(r.trace, r.trace_actions, constants))
+    elif r.deadlock:
+        print("Error: Deadlock reached.")
+        print("The behavior up to this point is:")
+        print(render_trace(r.trace, r.trace_actions, constants))
+    print(
+        f"{r.distinct_states} distinct states found, "
+        f"search depth (diameter) {r.diameter}."
+    )
+    print(
+        f"Finished in {wall:.1f}s "
+        f"({r.states_per_sec:.0f} distinct states/sec)."
+    )
+    if r.violation or r.deadlock:
+        return 1
+    if getattr(r, "truncated", False):
+        print(
+            "WARNING: search truncated by the state/time budget — the state "
+            "space was NOT exhausted; absence of violations is inconclusive."
+        )
+        return 3
+    return 0
+
+
+def _check_interp(args, module, spec_path, tlc_cfg, invariants):
+    """Generic-interpreter check path: any spec in the supported subset."""
+    from pulsar_tlaplus_tpu.engine.interp_check import InterpChecker
+    from pulsar_tlaplus_tpu.frontend.interp import Spec
+    from pulsar_tlaplus_tpu.frontend.loader import bind_cfg
+    from pulsar_tlaplus_tpu.frontend.parser import parse_file
+
+    if args.simulate or args.sharded or args.liveness_property:
+        sys.exit(
+            "tpu-tlc: -simulate/-sharded/-property need a compiled model "
+            f"and the generic-interpreter path was selected for '{module}' "
+            f"({'-interp forced' if args.interp else 'module not in the compiled registry'}); "
+            "the interpreter path is exhaustive BFS only"
+        )
+    if args.checkpoint or args.recover or args.metrics:
+        sys.exit(
+            "tpu-tlc: -checkpoint/-recover/-metrics are not supported on "
+            "the generic-interpreter path yet"
+        )
+    ast = parse_file(spec_path)
+    consts = bind_cfg(ast, tlc_cfg)
+    interned = consts.pop("__string_interning__", None) or {}
+    spec = Spec(ast, consts)
+    spec.check_assumes()
+    print(
+        f"tpu-tlc: checking {module} @ {spec_path} via the generic "
+        f"interpreter (invariants: {list(invariants) or 'none'})"
+    )
+    for cname, mapping in interned.items():
+        pairs = ", ".join(f'"{s}" -> {i}' for s, i in mapping.items())
+        print(f"tpu-tlc: note: {cname} strings interned as naturals: {pairs}")
+    t0 = time.time()
+    try:
+        ck = InterpChecker(
+            spec,
+            invariants=invariants,
+            check_deadlock=not args.nodeadlock,
+            max_states=args.maxstates,
+        )
+        r = ck.run()
+    except ValueError as e:
+        sys.exit(f"tpu-tlc: {e}")
+    return _report(r, None, time.time() - t0)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="tpu-tlc")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -86,6 +164,12 @@ def main(argv=None):
     pc.add_argument(
         "-cpu", action="store_true", help="force the CPU backend"
     )
+    pc.add_argument(
+        "-interp",
+        action="store_true",
+        help="force the generic-interpreter path (host BFS; works for any "
+        "spec in the supported TLA+ subset, no compiled model needed)",
+    )
     pc.add_argument("-chunk", type=int, default=4096)
     pc.add_argument("-maxstates", type=int, default=200_000_000)
     args = p.parse_args(argv)
@@ -100,26 +184,21 @@ def main(argv=None):
 
     spec_path = args.spec
     module = os.path.splitext(os.path.basename(spec_path))[0]
-    if module != "compaction":
-        sys.exit(
-            f"tpu-tlc: unknown module '{module}': the compiled-spec registry "
-            "currently contains: compaction"
-        )
     cfg_path = args.config or os.path.splitext(spec_path)[0] + ".cfg"
     if not os.path.exists(cfg_path):
         sys.exit(f"tpu-tlc: config file not found: {cfg_path}")
     tlc_cfg = cfgmod.load(cfg_path)
-    constants = cfgmod.to_constants(tlc_cfg)
     invariants = tuple(args.invariant or tlc_cfg.invariants)
 
-    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
-    from pulsar_tlaplus_tpu.ref import pyeval
+    from pulsar_tlaplus_tpu.models import registry
 
-    unknown = [i for i in invariants if i not in pyeval.INVARIANTS]
+    if args.interp or module not in registry.COMPILED:
+        return _check_interp(args, module, spec_path, tlc_cfg, invariants)
+
+    model, constants = registry.COMPILED[module](tlc_cfg)
+    unknown = [i for i in invariants if i not in model.invariants]
     if unknown:
         sys.exit(f"tpu-tlc: unknown invariant(s): {unknown}")
-
-    model = CompactionModel(constants)
     print(
         f"tpu-tlc: checking {module} @ {cfg_path} "
         f"(state width {model.layout.total_bits} bits, "
@@ -205,24 +284,7 @@ def main(argv=None):
         r = ck.run(resume=args.recover) if not args.sharded else ck.run()
     except ValueError as e:
         sys.exit(f"tpu-tlc: {e}")
-    wall = time.time() - t0
-    if r.violation and r.violation != "Deadlock":
-        print(f"Error: Invariant {r.violation} is violated.")
-        print("The behavior up to this point is:")
-        print(render_trace(r.trace, r.trace_actions, constants))
-    elif r.deadlock:
-        print("Error: Deadlock reached.")
-        print("The behavior up to this point is:")
-        print(render_trace(r.trace, r.trace_actions, constants))
-    print(
-        f"{r.distinct_states} distinct states found, "
-        f"search depth (diameter) {r.diameter}."
-    )
-    print(
-        f"Finished in {wall:.1f}s "
-        f"({r.states_per_sec:.0f} distinct states/sec)."
-    )
-    return 1 if (r.violation or r.deadlock) else 0
+    return _report(r, constants, time.time() - t0)
 
 
 if __name__ == "__main__":

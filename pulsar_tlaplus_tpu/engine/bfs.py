@@ -35,7 +35,6 @@ import numpy as np
 
 from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
 from pulsar_tlaplus_tpu.engine.statelog import FileLog, MemoryLog
-from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.ref import pyeval
 
@@ -59,8 +58,8 @@ class Checker:
 
     def __init__(
         self,
-        model: CompactionModel,
-        invariants: Tuple[str, ...] = pyeval.DEFAULT_INVARIANTS,
+        model,
+        invariants: Optional[Tuple[str, ...]] = None,
         check_deadlock: bool = True,
         frontier_chunk: int = 4096,
         visited_cap: int = 1 << 13,
@@ -75,6 +74,10 @@ class Checker:
     ):
         self.model = model
         self.layout = model.layout
+        if invariants is None:
+            invariants = getattr(
+                model, "default_invariants", pyeval.DEFAULT_INVARIANTS
+            )
         self.invariant_names = tuple(invariants)
         self.check_deadlock = check_deadlock
         self.F = frontier_chunk

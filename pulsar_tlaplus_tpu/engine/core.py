@@ -99,10 +99,11 @@ def build_trace(model, unpack1, gid: int, log):
         g = log.get(g)[1]
     chain.reverse()
     states, actions = [], []
+    names = getattr(model, "action_names", pyeval.ACTION_NAMES)
     for i, g in enumerate(chain):
         row, _parent, action = log.get(g)
         s = unpack1(jnp.asarray(row))
         states.append(model.to_pystate(s))
         if i > 0:
-            actions.append(pyeval.ACTION_NAMES[action])
+            actions.append(names[action])
     return states, actions

@@ -55,11 +55,16 @@ class LivenessChecker:
         visited_cap: int = 1 << 14,
         max_states: int = 5_000_000,
     ):
-        if goal != "Termination":
-            raise ValueError(f"unknown liveness property: {goal}")
+        goals = getattr(model, "liveness_goals", {})
+        if goal not in goals:
+            raise ValueError(
+                f"unknown liveness property: {goal} "
+                f"(model defines: {sorted(goals) or 'none'})"
+            )
         if fairness not in ("none", "wf_next"):
             raise ValueError(f"unknown fairness: {fairness}")
         self.model = model
+        self.goal_fn = goals[goal]
         self.fairness = fairness
         self.F = frontier_chunk
         self._checker = Checker(
@@ -83,7 +88,7 @@ class LivenessChecker:
         n = len(packed)
         n_init = rs.level_sizes[0]
 
-        goal_fn = jax.jit(jax.vmap(lambda w: m.termination_goal(layout.unpack(w))))
+        goal_fn = jax.jit(jax.vmap(lambda w: self.goal_fn(layout.unpack(w))))
         goal = np.zeros((n,), bool)
         for start in range(0, n, self.F):
             chunk = packed[start : start + self.F]

@@ -35,7 +35,6 @@ from jax.sharding import PartitionSpec as P
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
-from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 from pulsar_tlaplus_tpu.ops import dedup
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.parallel.mesh import AXIS, make_mesh
@@ -47,9 +46,9 @@ class ShardedChecker:
 
     def __init__(
         self,
-        model: CompactionModel,
+        model,
         n_devices: int | None = None,
-        invariants: Tuple[str, ...] = pyeval.DEFAULT_INVARIANTS,
+        invariants: Optional[Tuple[str, ...]] = None,
         check_deadlock: bool = True,
         frontier_chunk: int = 1024,
         visited_cap: int = 1 << 13,
@@ -60,6 +59,10 @@ class ShardedChecker:
         self.layout = model.layout
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.n_shards = self.mesh.devices.size
+        if invariants is None:
+            invariants = getattr(
+                model, "default_invariants", pyeval.DEFAULT_INVARIANTS
+            )
         self.invariant_names = tuple(invariants)
         self.check_deadlock = check_deadlock
         self.F = frontier_chunk

@@ -3,12 +3,16 @@ as a batch of vmapped random walkers with per-lane PRNG keys.
 
 Each walker starts from a uniformly drawn initial state and takes ``depth``
 random steps; at each step one enabled ``Next`` lane is chosen uniformly
-(stuttering lanes — Consumer/Terminating — keep the state, matching TLC's
-behavior-space semantics).  Invariants are evaluated on every visited
-state.  No dedup table is needed, so throughput scales with walker count.
+(stuttering lanes — e.g. compaction's Consumer/Terminating — keep the
+state, matching TLC's behavior-space semantics).  Invariants are evaluated
+on every visited state.  No dedup table is needed, so throughput scales
+with walker count.
 
-The whole rollout is one ``lax.scan`` under ``jit``; the action log is
-returned so a violating behavior can be replayed exactly on the host."""
+The whole rollout is one ``lax.scan`` under ``jit``; on violation the
+offending walker is *replayed* on device with the same PRNG key (the
+rollout is deterministic given the key), this time materializing every
+visited state, to reconstruct the behavior exactly — model-agnostic, no
+host re-evaluation of the spec needed."""
 
 from __future__ import annotations
 
@@ -19,7 +23,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 from pulsar_tlaplus_tpu.ref import pyeval
 
 
@@ -36,77 +39,106 @@ class SimulationResult:
 class Simulator:
     def __init__(
         self,
-        model: CompactionModel,
-        invariants: Tuple[str, ...] = pyeval.DEFAULT_INVARIANTS,
+        model,
+        invariants: Optional[Tuple[str, ...]] = None,
         n_walkers: int = 4096,
         depth: int = 64,
         seed: int = 0,
     ):
         self.model = model
+        if invariants is None:
+            invariants = getattr(
+                model, "default_invariants", pyeval.DEFAULT_INVARIANTS
+            )
         self.invariant_names = tuple(invariants)
         self.B = n_walkers
         self.T = depth
         self.seed = seed
 
+    # -- one walker's pieces (shared by rollout and replay) ----------------
+
+    def _init_one(self, k):
+        m = self.model
+        sampler = getattr(m, "sample_initial", None)
+        if sampler is not None:
+            return sampler(k)
+        # default: uniform over the Init fanout by drawing the index — only
+        # valid when n_initial fits i32; bigger fanouts must provide
+        # ``sample_initial`` or sampling would silently stop being uniform.
+        if m.n_initial > 2**31 - 1:
+            raise ValueError(
+                f"n_initial = {m.n_initial} exceeds int32: the model must "
+                "provide sample_initial(key) for simulation mode"
+            )
+        idx = jax.random.randint(k, (), 0, m.n_initial, jnp.int32)
+        return m.gen_initial(idx)
+
+    def _step_one(self, state, k, inv_fns):
+        m = self.model
+        succ, valid = m.successors(state)
+        stutter = m.stutter_enabled(state)
+        # uniform over enabled lanes; one extra lane = stutter (stay)
+        weights = jnp.concatenate(
+            [valid.astype(jnp.float32), stutter.astype(jnp.float32)[None]]
+        )
+        total = jnp.sum(weights)
+        # no enabled lane at all -> stay put (the exhaustive checker is
+        # what reports deadlocks; simulation just stops progressing)
+        fallback = jnp.zeros((m.A + 1,)).at[m.A].set(1.0)
+        probs = jnp.where(total > 0, weights / jnp.maximum(total, 1.0), fallback)
+        lane = jax.random.choice(k, m.A + 1, p=probs)
+        is_stutter = lane >= m.A
+        lane_c = jnp.minimum(lane, m.A - 1)
+        nxt = jax.tree.map(
+            lambda cur, s: jnp.where(is_stutter, cur, s[lane_c]),
+            state,
+            succ,
+        )
+        ok = (
+            jnp.stack([f(nxt) for f in inv_fns])
+            if inv_fns
+            else jnp.ones((0,), bool)
+        )
+        return nxt, (jnp.where(is_stutter, -1, lane_c).astype(jnp.int32), ok)
+
     def _rollout(self, key):
         m = self.model
         inv_fns = [m.invariants[n] for n in self.invariant_names]
 
-        def init_one(k):
-            if m.c.model_producer:
-                return m.gen_initial(jnp.int32(0))
-            # Sample each position's (key, value) digit directly — uniform
-            # over the Init fanout without materializing n_initial (which
-            # overflows any machine int for large MessageSentLimit).
-            digits = jax.random.randint(
-                k, (m.M,), 0, m.kv, jnp.int32
-            )
-            base = m.gen_initial(jnp.int32(0))
-            return base._replace(
-                keys=digits // (m.c.num_values + 1),
-                vals=digits % (m.c.num_values + 1),
-            )
-
-        def step_one(state, k):
-            succ, valid = m.successors(state)
-            stutter = m.stutter_enabled(state)
-            # uniform over enabled lanes; one extra lane = stutter (stay)
-            weights = jnp.concatenate(
-                [valid.astype(jnp.float32), stutter.astype(jnp.float32)[None]]
-            )
-            total = jnp.sum(weights)
-            # no enabled lane at all -> stay put (the exhaustive checker is
-            # what reports deadlocks; simulation just stops progressing)
-            fallback = jnp.zeros((m.A + 1,)).at[m.A].set(1.0)
-            probs = jnp.where(total > 0, weights / jnp.maximum(total, 1.0), fallback)
-            lane = jax.random.choice(k, m.A + 1, p=probs)
-            is_stutter = lane >= m.A
-            lane_c = jnp.minimum(lane, m.A - 1)
-            nxt = jax.tree.map(
-                lambda cur, s: jnp.where(is_stutter, cur, s[lane_c]),
-                state,
-                succ,
-            )
-            ok = jnp.stack([f(nxt) for f in inv_fns]) if inv_fns else jnp.ones((0,), bool)
-            return nxt, (jnp.where(is_stutter, -1, lane_c).astype(jnp.int32), ok)
-
         def walker(k):
             k0, krest = jax.random.split(k)
-            s0 = init_one(k0)
+            s0 = self._init_one(k0)
             ok0 = (
-                jnp.stack([f(s0) for f in inv_fns]) if inv_fns else jnp.ones((0,), bool)
+                jnp.stack([f(s0) for f in inv_fns])
+                if inv_fns
+                else jnp.ones((0,), bool)
             )
             ks = jax.random.split(krest, self.T)
-            _, (lanes, oks) = jax.lax.scan(step_one, s0, ks)
+            _, (lanes, oks) = jax.lax.scan(
+                lambda s, kk: self._step_one(s, kk, inv_fns), s0, ks
+            )
             return s0, ok0, lanes, oks
 
         keys = jax.random.split(key, self.B)
         return jax.vmap(walker)(keys)
 
+    def _replay(self, walker_key):
+        """Re-run one walker, materializing every visited state."""
+        k0, krest = jax.random.split(walker_key)
+        s0 = self._init_one(k0)
+        ks = jax.random.split(krest, self.T)
+
+        def step(s, kk):
+            nxt, (lane, _ok) = self._step_one(s, kk, [])
+            return nxt, (nxt, lane)
+
+        _, (states, lanes) = jax.lax.scan(step, s0, ks)
+        return s0, states, lanes
+
     def run(self) -> SimulationResult:
         m = self.model
         key = jax.random.PRNGKey(self.seed)
-        s0, ok0, lanes, oks = jax.jit(self._rollout)(key)
+        _s0, ok0, _lanes, oks = jax.jit(self._rollout)(key)
         oks = np.asarray(oks)  # [B, T, n_inv]
         ok0 = np.asarray(ok0)  # [B, n_inv]
         res = SimulationResult(
@@ -128,31 +160,20 @@ class Simulator:
             return res
         b, t_viol, inv_i = first
         res.violation = self.invariant_names[inv_i]
-        # replay walker b on the host through the oracle semantics
-        state = m.to_pystate(jax.tree.map(lambda x: np.asarray(x)[b], s0))
-        trace = [state]
+        # replay walker b on device with its key; collect the behavior
+        walker_key = jax.random.split(key, self.B)[b]
+        s0, states, lanes = jax.jit(self._replay)(walker_key)
+        lane_log = np.asarray(lanes)
+        names = getattr(m, "action_names", pyeval.ACTION_NAMES)
+        take = lambda tree, i: jax.tree.map(lambda x: np.asarray(x)[i], tree)
+        trace = [m.to_pystate(jax.tree.map(np.asarray, s0))]
         actions: List[str] = []
-        lane_log = np.asarray(lanes)[b]
         for step in range(t_viol):
             lane = int(lane_log[step])
             if lane < 0:
                 continue  # stutter: state unchanged, not part of the trace
-            aid = int(m.action_ids[lane])
-            succ = dict(pyeval.successors(m.c, state))
-            # Producer lanes share action id 0; disambiguate by lane k/v
-            if aid == 0:
-                kv = lane  # producer lanes come first, in (key, value) order
-                key_v = kv // (m.c.num_values + 1)
-                val_v = kv % (m.c.num_values + 1)
-                nxt = state._replace(
-                    messages=state.messages
-                    + ((len(state.messages) + 1, key_v, val_v),)
-                )
-            else:
-                nxt = succ[aid]
-            trace.append(nxt)
-            actions.append(pyeval.ACTION_NAMES[aid])
-            state = nxt
+            trace.append(m.to_pystate(take(states, step)))
+            actions.append(names[int(m.action_ids[lane])])
         res.trace = trace
         res.trace_actions = actions
         return res

@@ -49,6 +49,9 @@ class CompactionModel:
             [0] * self.n_producer_lanes + [1, 2, 3, 4, 5, 6, 7], dtype=np.int32
         )
         self.A = len(self.action_ids)
+        # generic engine protocol (engine/core.py, engine/liveness.py)
+        self.action_names = pyeval.ACTION_NAMES
+        self.default_invariants = pyeval.DEFAULT_INVARIANTS
         self._pos = jnp.arange(1, self.M + 1, dtype=jnp.int32)  # [M], 1-based
 
     # ------------------------------------------------------------------
@@ -141,6 +144,21 @@ class CompactionModel:
             context=zero,
             crash=zero,
             consume=zero,
+        )
+
+    def sample_initial(self, k) -> SState:
+        """Uniform random initial state (simulation mode protocol).
+
+        Samples each position's (key, value) digit directly — uniform over
+        the Init fanout without materializing ``n_initial``, which
+        overflows any machine int at large MessageSentLimit."""
+        if self.c.model_producer:
+            return self.gen_initial(jnp.int32(0))
+        digits = jax.random.randint(k, (self.M,), 0, self.kv, jnp.int32)
+        base = self.gen_initial(jnp.int32(0))
+        return base._replace(
+            keys=digits // (self.c.num_values + 1),
+            vals=digits % (self.c.num_values + 1),
         )
 
     # ------------------------------------------------------------------
@@ -396,6 +414,11 @@ class CompactionModel:
             "CompactionHorizonCorrectness": self.compaction_horizon_correctness,
             "DuplicateNullKeyMessage": self.duplicate_null_key_message,
         }
+
+    @property
+    def liveness_goals(self) -> Dict[str, Callable[[SState], jax.Array]]:
+        """Named ``<>goal`` predicates (engine/liveness.py protocol)."""
+        return {"Termination": self.termination_goal}
 
     # ------------------------------------------------------------------
     # host-side conversions to/from the oracle's structural states

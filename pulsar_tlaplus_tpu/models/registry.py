@@ -1,0 +1,29 @@
+"""Compiled-model registry: TLA+ module name -> TPU-native model factory.
+
+Each factory takes the parsed TLC config (``utils.cfg.TLCConfig``) and
+returns ``(model, constants)`` where ``model`` implements the engine
+protocol (layout / successors / invariants / gen_initial / action_names /
+default_invariants / to_pystate, see engine/bfs.py) and ``constants`` is
+the model's native constants object (used for trace rendering).
+
+Specs not present here are still checkable through the generic
+interpreter path (engine/interp_check.py) — the registry is the TPU hot
+path, not a capability gate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+
+def _compaction(tlc_cfg) -> Tuple[object, object]:
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+    constants = cfgmod.to_constants(tlc_cfg)
+    return CompactionModel(constants), constants
+
+
+COMPILED: Dict[str, Callable] = {
+    "compaction": _compaction,
+}

@@ -45,6 +45,107 @@ def bitlen(n: int) -> int:
     return n.bit_length()
 
 
+class StructLayout:
+    """Generic fixed-width bit layout over a user NamedTuple state class.
+
+    The model-agnostic counterpart of the hand-tuned compaction ``Layout``
+    (SURVEY.md §7-L0): a compiled spec model declares its state as a
+    NamedTuple of int32 scalars / vectors / matrices plus a ``specs`` map
+    ``field -> (shape, width_bits)`` and gets canonical ``pack``/``unpack``
+    kernels for free.  Fields are packed in NamedTuple field order,
+    row-major within a field.  Widths must be <= 32; every element must be
+    a non-negative integer < 2**width (canonical-form obligation on the
+    model's kernels, as for ``Layout``).
+    """
+
+    def __init__(self, state_cls, specs: dict):
+        self.state_cls = state_cls
+        missing = [f for f in state_cls._fields if f not in specs]
+        if missing:
+            raise ValueError(f"specs missing fields: {missing}")
+        self.fields = []
+        total = 0
+        for name in state_cls._fields:
+            shape, width = specs[name]
+            shape = tuple(shape)
+            if not 0 <= width <= 32:
+                raise ValueError(f"{name}: width {width} not in 0..32")
+            n_elems = 1
+            for d in shape:
+                n_elems *= d
+            self.fields.append((name, shape, width, n_elems))
+            total += n_elems * width
+        self.total_bits = total
+        self.W = max(1, math.ceil(total / 32))
+
+    def _flat(self, s):
+        """Ordered (scalar u32-castable value, width) stream."""
+        items = []
+        for name, shape, width, n_elems in self.fields:
+            v = getattr(s, name)
+            if shape == ():
+                items.append((v, width))
+            else:
+                flat = jnp.reshape(v, (n_elems,))
+                for i in range(n_elems):
+                    items.append((flat[i], width))
+        return items
+
+    def pack(self, s) -> jax.Array:
+        """One state -> u32[W].  vmap for batches."""
+        words = [jnp.uint32(0)] * self.W
+        pos = 0
+        for val, width in self._flat(s):
+            if width == 0:
+                continue
+            mask = (
+                jnp.uint32((1 << width) - 1)
+                if width < 32
+                else jnp.uint32(0xFFFFFFFF)
+            )
+            v = val.astype(jnp.uint32) & mask
+            w, off = divmod(pos, 32)
+            words[w] = words[w] | (v << jnp.uint32(off))
+            if off + width > 32:
+                words[w + 1] = words[w + 1] | (v >> jnp.uint32(32 - off))
+            pos += width
+        return jnp.stack(words)
+
+    def unpack(self, words: jax.Array):
+        """u32[W] -> one state.  vmap for batches."""
+        pos = 0
+
+        def read(width: int) -> jax.Array:
+            nonlocal pos
+            if width == 0:
+                return jnp.int32(0)
+            w, off = divmod(pos, 32)
+            lo = words[w] >> jnp.uint32(off)
+            if off + width > 32:
+                lo = lo | (words[w + 1] << jnp.uint32(32 - off))
+            mask = (
+                jnp.uint32((1 << width) - 1)
+                if width < 32
+                else jnp.uint32(0xFFFFFFFF)
+            )
+            pos += width
+            return lo & mask
+
+        out = {}
+        for name, shape, width, n_elems in self.fields:
+            if shape == ():
+                out[name] = read(width).astype(jnp.int32)
+            else:
+                elems = [read(width).astype(jnp.int32) for _ in range(n_elems)]
+                arr = (
+                    jnp.stack(elems).reshape(shape)
+                    if n_elems
+                    else jnp.zeros(shape, jnp.int32)
+                )
+                out[name] = arr
+        return self.state_cls(**out)
+
+
 class SState(NamedTuple):
     """Struct-of-scalars state (one TLA+ state; batch via vmap).
 

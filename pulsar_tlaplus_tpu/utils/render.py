@@ -24,7 +24,11 @@ def _seq(entries) -> str:
     return "<<" + ", ".join(_msg(m) for m in entries) + ">>"
 
 
-def render_state(s: pyeval.State, c) -> str:
+def render_state(s, c) -> str:
+    if isinstance(s, dict):
+        # generic model protocol: to_pystate returns an ordered mapping
+        # TLA+ variable name -> rendered value (str or plain value)
+        return "\n".join(f"/\\ {k} = {v}" for k, v in s.items())
     lines = []
     lines.append(f"/\\ messages = {_seq(s.messages)}")
     led = ", ".join(

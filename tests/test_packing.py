@@ -1,6 +1,8 @@
 """Packed-state codec property tests (SURVEY.md §4c): pack-unpack identity
 and injectivity over oracle-reachable states."""
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,3 +37,52 @@ def test_layout_width_shipped():
     m = CompactionModel(SMALL_CONFIGS["shipped"])
     assert m.layout.total_bits <= 64  # fits 2 words -> exact (identity) keys
     assert m.layout.W == 2
+
+
+class _ToyState(NamedTuple):
+    a: jax.Array  # scalar
+    b: jax.Array  # vector[3]
+    m: jax.Array  # matrix[2, 2]
+
+
+_TOY_SPECS = {"a": ((), 5), "b": ((3,), 7), "m": ((2, 2), 3)}
+
+
+def test_struct_layout_roundtrip():
+    from pulsar_tlaplus_tpu.ops.packing import StructLayout
+
+    lay = StructLayout(_ToyState, _TOY_SPECS)
+    assert lay.total_bits == 5 + 3 * 7 + 4 * 3
+    rng = np.random.default_rng(7)
+    seen = set()
+    for _ in range(200):
+        s = _ToyState(
+            a=jnp.int32(rng.integers(0, 32)),
+            b=jnp.asarray(rng.integers(0, 128, 3), jnp.int32),
+            m=jnp.asarray(rng.integers(0, 8, (2, 2)), jnp.int32),
+        )
+        w = lay.pack(s)
+        assert w.shape == (lay.W,)
+        back = lay.unpack(w)
+        assert int(back.a) == int(s.a)
+        assert np.array_equal(np.asarray(back.b), np.asarray(s.b))
+        assert np.array_equal(np.asarray(back.m), np.asarray(s.m))
+        seen.add(tuple(np.asarray(w).tolist()))
+    # word-spanning fields: b's 7-bit elements cross the 32-bit boundary
+    assert lay.W == 2
+
+
+def test_struct_layout_vmap_jit():
+    from pulsar_tlaplus_tpu.ops.packing import StructLayout
+
+    lay = StructLayout(_ToyState, _TOY_SPECS)
+    batch = _ToyState(
+        a=jnp.arange(4, dtype=jnp.int32),
+        b=jnp.arange(12, dtype=jnp.int32).reshape(4, 3) % 128,
+        m=jnp.arange(16, dtype=jnp.int32).reshape(4, 2, 2) % 8,
+    )
+    words = jax.jit(jax.vmap(lay.pack))(batch)
+    back = jax.jit(jax.vmap(lay.unpack))(words)
+    assert np.array_equal(np.asarray(back.a), np.asarray(batch.a))
+    assert np.array_equal(np.asarray(back.b), np.asarray(batch.b))
+    assert np.array_equal(np.asarray(back.m), np.asarray(batch.m))
