@@ -76,20 +76,22 @@ def _check_interp(args, module, spec_path, tlc_cfg, invariants):
             "tpu-tlc: -checkpoint/-recover/-metrics are not supported on "
             "the generic-interpreter path yet"
         )
-    ast = parse_file(spec_path)
-    consts = bind_cfg(ast, tlc_cfg)
-    interned = consts.pop("__string_interning__", None) or {}
-    spec = Spec(ast, consts)
-    spec.check_assumes()
-    print(
-        f"tpu-tlc: checking {module} @ {spec_path} via the generic "
-        f"interpreter (invariants: {list(invariants) or 'none'})"
-    )
-    for cname, mapping in interned.items():
-        pairs = ", ".join(f'"{s}" -> {i}' for s, i in mapping.items())
-        print(f"tpu-tlc: note: {cname} strings interned as naturals: {pairs}")
     t0 = time.time()
     try:
+        ast = parse_file(spec_path)
+        consts = bind_cfg(ast, tlc_cfg)
+        interned = consts.pop("__string_interning__", None) or {}
+        spec = Spec(ast, consts)
+        spec.check_assumes()
+        print(
+            f"tpu-tlc: checking {module} @ {spec_path} via the generic "
+            f"interpreter (invariants: {list(invariants) or 'none'})"
+        )
+        for cname, mapping in interned.items():
+            pairs = ", ".join(f'"{s}" -> {i}' for s, i in mapping.items())
+            print(
+                f"tpu-tlc: note: {cname} strings interned as naturals: {pairs}"
+            )
         ck = InterpChecker(
             spec,
             invariants=invariants,
@@ -97,7 +99,9 @@ def _check_interp(args, module, spec_path, tlc_cfg, invariants):
             max_states=args.maxstates,
         )
         r = ck.run()
-    except ValueError as e:
+    except (ValueError, OSError) as e:
+        # ParseError/LexError/EvalError subclass ValueError; OSError covers
+        # a missing/unreadable spec file
         sys.exit(f"tpu-tlc: {e}")
     return _report(r, None, time.time() - t0)
 
@@ -195,7 +199,10 @@ def main(argv=None):
     if args.interp or module not in registry.COMPILED:
         return _check_interp(args, module, spec_path, tlc_cfg, invariants)
 
-    model, constants = registry.COMPILED[module](tlc_cfg)
+    try:
+        model, constants = registry.COMPILED[module](tlc_cfg)
+    except ValueError as e:
+        sys.exit(f"tpu-tlc: {e}")
     unknown = [i for i in invariants if i not in model.invariants]
     if unknown:
         sys.exit(f"tpu-tlc: unknown invariant(s): {unknown}")

@@ -57,14 +57,12 @@ def format_value(v) -> str:
             + ")"
         )
     if isinstance(v, frozenset):
-        return (
-            "{"
-            + ", ".join(
-                format_value(x)
-                for x in sorted(v, key=lambda x: (str(type(x)), str(x)))
-            )
-            + "}"
+        # numeric order within int runs; other types sort by rendering
+        key = lambda x: (
+            (0, x, "") if isinstance(x, int) and not isinstance(x, bool)
+            else (1, 0, str(type(x)) + format_value(x))
         )
+        return "{" + ", ".join(format_value(x) for x in sorted(v, key=key)) + "}"
     return repr(v)
 
 

@@ -24,6 +24,25 @@ def _compaction(tlc_cfg) -> Tuple[object, object]:
     return CompactionModel(constants), constants
 
 
+def _require(tlc_cfg, *names):
+    missing = [n for n in names if n not in tlc_cfg.constants]
+    if missing:
+        raise ValueError(f"cfg binds no CONSTANT {', '.join(missing)}")
+    return [int(tlc_cfg.constants[n]) for n in names]
+
+
+def _subscription(tlc_cfg) -> Tuple[object, object]:
+    from pulsar_tlaplus_tpu.models.subscription import (
+        SubscriptionConstants,
+        SubscriptionModel,
+    )
+
+    ml, mc = _require(tlc_cfg, "MessageLimit", "MaxCrashTimes")
+    c = SubscriptionConstants(message_limit=ml, max_crash_times=mc)
+    return SubscriptionModel(c), c
+
+
 COMPILED: Dict[str, Callable] = {
     "compaction": _compaction,
+    "subscription": _subscription,
 }

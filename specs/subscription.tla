@@ -1,0 +1,197 @@
+---------------------------- MODULE subscription ----------------------------
+(***************************************************************************)
+(* Model of an Apache Pulsar subscription's cursor protocol: message       *)
+(* dispatch, individual acknowledgment, mark-delete advancement, and       *)
+(* redelivery of unacknowledged messages after a consumer crash.           *)
+(*                                                                         *)
+(* The modeled roles:                                                      *)
+(*   - producer: publishes messages 1..MessageLimit in order;              *)
+(*   - broker:   dispatches unacked messages past the cursor, receives     *)
+(*               individual acks, advances the durable mark-delete         *)
+(*               position over the contiguous acked prefix (Pulsar's       *)
+(*               ManagedCursor semantics: individuallyDeletedMessages      *)
+(*               beyond markDeletePosition, merged as holes fill);         *)
+(*   - consumer: processes in-flight messages and acks them; may crash,    *)
+(*               losing its in-flight messages AND its not-yet-sent acks   *)
+(*               (both are redelivered -> at-least-once delivery).         *)
+(*                                                                         *)
+(* The per-message lifecycle:                                              *)
+(*     unread -> delivered (in flight) -> pending (processed, ack in       *)
+(*     flight) -> acked (broker-side) -> covered by markDelete.            *)
+(* ConsumerCrash returns `delivered` and `pending` messages to unread;     *)
+(* the application-level fact that a message was processed is monotone     *)
+(* (everProcessed), and a second processing records the id in              *)
+(* `duplicated` — making the at-least-once duplicate observable.           *)
+(*                                                                         *)
+(* Companion spec to compaction.tla from thetumbled/pulsar-tlaplus        *)
+(* (reference layout: compaction.tla:56-75 variable grouping,             *)
+(* compaction.tla:169-182 crash/recovery style, compaction.tla:205-214    *)
+(* Terminating self-loop convention).                                      *)
+(***************************************************************************)
+EXTENDS Naturals, FiniteSets
+
+CONSTANTS
+    MessageLimit,       \* how many messages the producer publishes
+    MaxCrashTimes       \* bound on consumer crash/reconnect cycles
+
+ASSUME
+    /\ MessageLimit \in Nat
+    /\ MessageLimit >= 1
+    /\ MaxCrashTimes \in Nat
+
+VARIABLES
+    produced,       \* count of published messages (ids 1..produced)
+    delivered,      \* ids in flight to the consumer, not yet processed
+    pending,        \* ids processed by the consumer, ack not yet on broker
+    acked,          \* ids individually acked beyond markDelete (ack holes)
+    markDelete,     \* durable cursor: every id <= markDelete is consumed
+    everProcessed,  \* history: ids the application processed at least once
+    duplicated,     \* history: ids the application processed MORE than once
+    crashTimes
+
+vars == <<produced, delivered, pending, acked, markDelete,
+          everProcessed, duplicated, crashTimes>>
+
+Ids == 1..MessageLimit
+
+Init ==
+    /\ produced = 0
+    /\ delivered = {}
+    /\ pending = {}
+    /\ acked = {}
+    /\ markDelete = 0
+    /\ everProcessed = {}
+    /\ duplicated = {}
+    /\ crashTimes = 0
+
+(* Producer publishes the next message. *)
+Publish ==
+    /\ produced < MessageLimit
+    /\ produced' = produced + 1
+    /\ UNCHANGED <<delivered, pending, acked, markDelete,
+                   everProcessed, duplicated, crashTimes>>
+
+(* Broker dispatches an unconsumed, un-dispatched message to the consumer.
+   A message that was processed but whose ack was lost in a crash is no
+   longer in `pending`, so it is dispatched AGAIN here — redelivery. *)
+Deliver ==
+    /\ \E m \in Ids :
+        /\ m <= produced
+        /\ m > markDelete
+        /\ m \notin delivered
+        /\ m \notin pending
+        /\ m \notin acked
+        /\ delivered' = delivered \cup {m}
+    /\ UNCHANGED <<produced, pending, acked, markDelete,
+                   everProcessed, duplicated, crashTimes>>
+
+(* Consumer processes an in-flight message (the application side effect
+   happens HERE); the ack is now outstanding.  Processing an id that was
+   already processed in a previous delivery is recorded in `duplicated`. *)
+Process ==
+    /\ \E m \in delivered :
+        /\ delivered' = delivered \ {m}
+        /\ pending' = pending \cup {m}
+        /\ everProcessed' = everProcessed \cup {m}
+        /\ duplicated' = IF m \in everProcessed
+                         THEN duplicated \cup {m}
+                         ELSE duplicated
+    /\ UNCHANGED <<produced, acked, markDelete, crashTimes>>
+
+(* Broker receives an individual ack (an "ack hole" until the prefix below
+   it is also acked). *)
+SendAck ==
+    /\ \E m \in pending :
+        /\ pending' = pending \ {m}
+        /\ acked' = acked \cup {m}
+    /\ UNCHANGED <<produced, delivered, markDelete,
+                   everProcessed, duplicated, crashTimes>>
+
+(* Cursor management: the mark-delete position swallows the next
+   contiguous acked id (Pulsar merges individuallyDeletedMessages into
+   markDeletePosition as holes fill). *)
+AdvanceMarkDelete ==
+    /\ (markDelete + 1) \in acked
+    /\ markDelete' = markDelete + 1
+    /\ acked' = acked \ {markDelete + 1}
+    /\ UNCHANGED <<produced, delivered, pending,
+                   everProcessed, duplicated, crashTimes>>
+
+(* Consumer crashes and reconnects: in-flight messages and in-flight acks
+   are lost; the broker will redeliver everything not individually acked
+   and not covered by markDelete.  Broker-side cursor state survives. *)
+ConsumerCrash ==
+    /\ crashTimes < MaxCrashTimes
+    /\ crashTimes' = crashTimes + 1
+    /\ delivered' = {}
+    /\ pending' = {}
+    /\ UNCHANGED <<produced, acked, markDelete,
+                   everProcessed, duplicated>>
+
+(* Self-loop at the drained end state so TLC reports no deadlock. *)
+Drained ==
+    /\ produced = MessageLimit
+    /\ markDelete = MessageLimit
+
+Terminating ==
+    /\ Drained
+    /\ UNCHANGED vars
+
+Next ==
+    \/ Publish
+    \/ Deliver
+    \/ Process
+    \/ SendAck
+    \/ AdvanceMarkDelete
+    \/ ConsumerCrash
+    \/ Terminating
+
+Spec == Init /\ [][Next]_vars
+
+-----------------------------------------------------------------------------
+(* Invariants *)
+
+TypeOK ==
+    /\ produced \in 0..MessageLimit
+    /\ markDelete \in 0..MessageLimit
+    /\ markDelete <= produced
+    /\ delivered \subseteq Ids
+    /\ pending \subseteq Ids
+    /\ acked \subseteq Ids
+    /\ everProcessed \subseteq Ids
+    /\ duplicated \subseteq everProcessed
+    /\ crashTimes \in 0..MaxCrashTimes
+    /\ delivered \cap pending = {}
+    /\ delivered \cap acked = {}
+    /\ pending \cap acked = {}
+    /\ \A m \in delivered \cup pending \cup acked :
+        /\ m > markDelete
+        /\ m <= produced
+
+(* The core cursor-safety property: the mark-delete position never covers
+   a message the application did not process — advancing the cursor is the
+   broker's promise the message was consumed. *)
+NoLostMessage ==
+    \A m \in 1..markDelete : m \in everProcessed
+
+(* Acks are only ever generated by processing. *)
+AckedWasProcessed ==
+    (acked \cup pending) \subseteq everProcessed
+
+(* Pulsar subscriptions are at-least-once: a crash between processing and
+   ack receipt forces redelivery, so this invariant is VIOLATED whenever
+   MaxCrashTimes >= 1 — enable it to obtain the duplicate-consumption
+   counterexample trace (the analog of compaction.tla's commented-out
+   known-bug invariants, compaction.tla:252,279). *)
+ExactlyOnceProcessing ==
+    duplicated = {}
+
+-----------------------------------------------------------------------------
+(* With weak fairness on Next the subscription drains: crashes are bounded,
+   so eventually every message is processed, acked, and covered by the
+   cursor.  Without fairness the spec may stutter forever (TLC semantics
+   for the raw Spec). *)
+Termination ==
+    <>Drained
+
+=============================================================================
