@@ -6,10 +6,12 @@ Usage mirrors ``java tlc2.TLC``:
         [-workers tpu | N] [-sharded N] [-invariant NAME ...]
         [-nodeadlock] [-cpu]
 
-``check`` runs exhaustive BFS model checking of the named spec (currently
-the hand-compiled ``compaction`` module; the spec->IR front end is the next
-layer, SURVEY.md §2.2-E1) and prints a TLC-style summary: distinct states,
-diameter, and a counterexample trace on invariant violation or deadlock.
+``check`` runs exhaustive BFS model checking of the named spec and prints
+a TLC-style summary: distinct states, diameter, and a counterexample trace
+on invariant violation or deadlock.  Modules with a compiled TPU model
+(``models/registry.py`` COMPILED) run on the JAX engines; anything else —
+or ``-interp`` — routes through the generic interpreter (host BFS,
+engine/interp_check.py).
 """
 
 from __future__ import annotations

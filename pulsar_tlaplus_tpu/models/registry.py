@@ -42,7 +42,32 @@ def _subscription(tlc_cfg) -> Tuple[object, object]:
     return SubscriptionModel(c), c
 
 
+def _bookkeeper(tlc_cfg) -> Tuple[object, object]:
+    from pulsar_tlaplus_tpu.models.bookkeeper import (
+        BookkeeperConstants,
+        BookkeeperModel,
+    )
+
+    e, qw, qa, l, mc = _require(
+        tlc_cfg,
+        "NumBookies",
+        "WriteQuorum",
+        "AckQuorum",
+        "EntryLimit",
+        "MaxBookieCrashes",
+    )
+    c = BookkeeperConstants(
+        num_bookies=e,
+        write_quorum=qw,
+        ack_quorum=qa,
+        entry_limit=l,
+        max_bookie_crashes=mc,
+    )
+    return BookkeeperModel(c), c
+
+
 COMPILED: Dict[str, Callable] = {
     "compaction": _compaction,
     "subscription": _subscription,
+    "bookkeeper": _bookkeeper,
 }

@@ -1,0 +1,179 @@
+----------------------------- MODULE bookkeeper -----------------------------
+(***************************************************************************)
+(* Model of Apache BookKeeper's ledger write path: a single writer adds    *)
+(* entries to a ledger striped over an ensemble of bookies with a write    *)
+(* quorum and an ack quorum, advancing the LastAddConfirmed (LAC) position *)
+(* as ack quorums complete, while bookies may crash and lose their data.   *)
+(*                                                                         *)
+(* The modeled roles:                                                      *)
+(*   - writer: sends entry e to its deterministic round-robin write set    *)
+(*             of WriteQuorum bookies; confirms e (advances LAC) once      *)
+(*             AckQuorum of them have acked; acks are monotone writer      *)
+(*             knowledge — a bookie crashing later does NOT revoke them;   *)
+(*   - bookie: persists a write, then its ack travels to the writer;       *)
+(*             a crash is permanent and loses ALL data on that bookie      *)
+(*             (node-replacement failure model, no autorecovery);          *)
+(*   - environment: at most MaxBookieCrashes crashes.                      *)
+(*                                                                         *)
+(* The headline property is BookKeeper's durability contract: a confirmed  *)
+(* entry survives as long as FEWER than AckQuorum bookies fail.  With      *)
+(* MaxBookieCrashes >= AckQuorum the invariant ConfirmedEntryReadable is   *)
+(* violated — the writer confirmed an entry to its client on AckQuorum     *)
+(* acks, then every bookie holding it crashed (the counterexample shows    *)
+(* exactly the ack-then-crash interleaving).                               *)
+(*                                                                         *)
+(* Companion spec to compaction.tla from thetumbled/pulsar-tlaplus         *)
+(* (crash-bounding and Terminating-self-loop conventions per               *)
+(* compaction.tla:169-182, 205-214).                                       *)
+(***************************************************************************)
+EXTENDS Naturals, FiniteSets
+
+CONSTANTS
+    NumBookies,        \* ensemble size E
+    WriteQuorum,       \* Qw: bookies each entry is written to
+    AckQuorum,         \* Qa: acks required to confirm an entry
+    EntryLimit,        \* how many entries the writer adds
+    MaxBookieCrashes   \* bound on bookie failures
+
+ASSUME
+    /\ NumBookies \in Nat /\ NumBookies >= 1
+    /\ WriteQuorum \in 1..NumBookies
+    /\ AckQuorum \in 1..WriteQuorum
+    /\ EntryLimit \in Nat /\ EntryLimit >= 1
+    /\ MaxBookieCrashes \in 0..NumBookies
+
+VARIABLES
+    added,    \* entries sent so far (ids 1..added)
+    stored,   \* [bookie -> set of entry ids persisted on it]
+    ackedBy,  \* [entry -> set of bookies whose ack reached the writer]
+    lac,      \* LastAddConfirmed: entries 1..lac are confirmed to clients
+    crashed   \* set of permanently failed bookies
+
+vars == <<added, stored, ackedBy, lac, crashed>>
+
+Bookies == 1..NumBookies
+Entries == 1..EntryLimit
+
+(* Round-robin striping: entry e goes to WriteQuorum bookies starting at
+   bookie ((e-1) % E) + 1 (BookKeeper's RoundRobinDistributionSchedule). *)
+WriteSet(e) == {((e - 1 + i) % NumBookies) + 1 : i \in 0..(WriteQuorum - 1)}
+
+Init ==
+    /\ added = 0
+    /\ stored = [b \in Bookies |-> {}]
+    /\ ackedBy = [e \in Entries |-> {}]
+    /\ lac = 0
+    /\ crashed = {}
+
+(* Writer sends the next entry (to its write set; landing is async). *)
+AddEntry ==
+    /\ added < EntryLimit
+    /\ added' = added + 1
+    /\ UNCHANGED <<stored, ackedBy, lac, crashed>>
+
+(* A pending write lands on a live write-set bookie. *)
+WriteLand ==
+    /\ \E b \in Bookies :
+        \E e \in Entries :
+            /\ e <= added
+            /\ b \in WriteSet(e)
+            /\ b \notin crashed
+            /\ e \notin stored[b]
+            /\ stored' = [stored EXCEPT ![b] = stored[b] \cup {e}]
+    /\ UNCHANGED <<added, ackedBy, lac, crashed>>
+
+(* A bookie's ack reaches the writer.  Writer knowledge is monotone: the
+   ack stays even if the bookie crashes afterwards — this is the race the
+   durability bound lives on. *)
+AckArrive ==
+    /\ \E b \in Bookies :
+        \E e \in Entries :
+            /\ e \in stored[b]
+            /\ b \notin ackedBy[e]
+            /\ ackedBy' = [ackedBy EXCEPT ![e] = ackedBy[e] \cup {b}]
+    /\ UNCHANGED <<added, stored, lac, crashed>>
+
+(* LAC advances in order once the next entry has an ack quorum. *)
+AdvanceLAC ==
+    /\ lac < added
+    /\ Cardinality(ackedBy[lac + 1]) >= AckQuorum
+    /\ lac' = lac + 1
+    /\ UNCHANGED <<added, stored, ackedBy, crashed>>
+
+(* Permanent bookie failure with data loss (node replacement). *)
+BookieCrash ==
+    /\ Cardinality(crashed) < MaxBookieCrashes
+    /\ \E b \in Bookies :
+        /\ b \notin crashed
+        /\ crashed' = crashed \cup {b}
+        /\ stored' = [stored EXCEPT ![b] = {}]
+    /\ UNCHANGED <<added, ackedBy, lac>>
+
+(* End states: all entries confirmed, or the next entry can never reach an
+   ack quorum (too many of its write-set bookies died before acking) and
+   the ledger is wedged.  Self-loop so TLC reports no deadlock. *)
+Wedged ==
+    /\ lac < added
+    /\ Cardinality(ackedBy[lac + 1]
+           \cup {b \in WriteSet(lac + 1) : b \notin crashed}) < AckQuorum
+
+Done ==
+    /\ added = EntryLimit
+    /\ \/ lac = EntryLimit
+       \/ Wedged
+
+Terminating ==
+    /\ Done
+    /\ UNCHANGED vars
+
+Next ==
+    \/ AddEntry
+    \/ WriteLand
+    \/ AckArrive
+    \/ AdvanceLAC
+    \/ BookieCrash
+    \/ Terminating
+
+Spec == Init /\ [][Next]_vars
+
+-----------------------------------------------------------------------------
+(* Invariants *)
+
+TypeOK ==
+    /\ added \in 0..EntryLimit
+    /\ lac \in 0..added
+    /\ crashed \subseteq Bookies
+    /\ Cardinality(crashed) <= MaxBookieCrashes
+    /\ \A b \in Bookies :
+        /\ stored[b] \subseteq Entries
+        /\ \A e \in stored[b] : e <= added /\ b \in WriteSet(e)
+    /\ \A e \in Entries :
+        /\ ackedBy[e] \subseteq WriteSet(e)
+        /\ \A b \in ackedBy[e] : e <= added
+    /\ \A b \in crashed : stored[b] = {}
+
+(* Confirmation is honest: every confirmed entry reached an ack quorum. *)
+LacIsConfirmed ==
+    \A e \in 1..lac : Cardinality(ackedBy[e]) >= AckQuorum
+
+(* Acks only come from bookies that stored the entry — unless the bookie
+   has since crashed (ack knowledge is monotone, storage is not). *)
+AckImpliesStoredOrCrashed ==
+    \A e \in Entries : \A b \in ackedBy[e] :
+        e \in stored[b] \/ b \in crashed
+
+(* BookKeeper's durability contract: a confirmed entry is still readable
+   somewhere.  HOLDS whenever MaxBookieCrashes < AckQuorum; VIOLATED as
+   soon as MaxBookieCrashes >= AckQuorum (every replica of a confirmed
+   entry can crash after acking) — enable it in such a cfg to get the
+   ack-then-crash counterexample trace. *)
+ConfirmedEntryReadable ==
+    \A e \in 1..lac : \E b \in Bookies : e \in stored[b]
+
+-----------------------------------------------------------------------------
+(* With weak fairness the ledger run always finishes: either everything
+   confirms or the ledger wedges on a crash-starved entry. *)
+Termination ==
+    <>Done
+
+=============================================================================

@@ -78,11 +78,10 @@ def test_exact_state_set_matches_interpreter(module):
                     expected.add(t)
                     new.append(t)
         frontier = new
-    m, rm = run_model(c)
-    log = Checker(m, frontier_chunk=256, keep_log=True)
-    r = log.run()
-    rs = log.last_run_state
-    packed = rs.log.packed_matrix()
+    m = SubscriptionModel(c)
+    ck = Checker(m, frontier_chunk=256, keep_log=True)
+    ck.run()
+    packed = ck.last_run_state.log.packed_matrix()
     unpack = jax.jit(m.layout.unpack)
     got = {
         m.to_interp_state(unpack(jnp.asarray(row))) for row in packed
