@@ -66,8 +66,24 @@ def _bookkeeper(tlc_cfg) -> Tuple[object, object]:
     return BookkeeperModel(c), c
 
 
+def _georeplication(tlc_cfg) -> Tuple[object, object]:
+    from pulsar_tlaplus_tpu.models.georeplication import (
+        GeoConstants,
+        GeoreplicationModel,
+    )
+
+    n, p, mc = _require(
+        tlc_cfg, "NumClusters", "PublishLimit", "MaxReplicatorCrashes"
+    )
+    c = GeoConstants(
+        num_clusters=n, publish_limit=p, max_replicator_crashes=mc
+    )
+    return GeoreplicationModel(c), c
+
+
 COMPILED: Dict[str, Callable] = {
     "compaction": _compaction,
     "subscription": _subscription,
     "bookkeeper": _bookkeeper,
+    "georeplication": _georeplication,
 }

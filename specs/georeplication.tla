@@ -1,0 +1,186 @@
+--------------------------- MODULE georeplication ---------------------------
+(***************************************************************************)
+(* Model of Apache Pulsar geo-replication across a full mesh of clusters   *)
+(* (the canonical deployment is 3).  Each cluster owns a local copy of the *)
+(* topic; producers publish locally, and a per-(source, destination)       *)
+(* replicator ships the source's LOCALLY-ORIGINATED messages to the other  *)
+(* clusters in order (origin marking prevents replication loops, so a      *)
+(* message hops exactly once: origin -> every other cluster).              *)
+(*                                                                         *)
+(* Each replicator is a Pulsar consumer on the source topic with its own   *)
+(* cursor.  The in-memory read position (`repCursor`) runs ahead of the    *)
+(* durably persisted position (`repAcked`) — acking is lazy, exactly like  *)
+(* the compaction cursor (reference compaction.tla:147-151).  When a       *)
+(* replicator crashes and fails over, it resumes from the durable          *)
+(* position and RE-SHIPS everything in (repAcked, repCursor] — Pulsar      *)
+(* geo-replication is at-least-once, and the `duplicated` history makes    *)
+(* the resulting duplicate deliveries observable (violated invariant      *)
+(* NoDuplicateDelivery, the known anomaly when broker deduplication is     *)
+(* not enabled on the remote topic).                                       *)
+(*                                                                         *)
+(* Message identity is (origin cluster, per-origin seqno); per-pair        *)
+(* delivery is in seqno order, so the set of messages dst holds from src   *)
+(* is always the prefix 1..recvHwm[dst][src] — recvHwm is the monotone     *)
+(* high watermark (it never rewinds; only the cursor does).                *)
+(***************************************************************************)
+EXTENDS Naturals, FiniteSets
+
+CONSTANTS
+    NumClusters,          \* mesh size (headline config: 3)
+    PublishLimit,         \* messages published per cluster
+    MaxReplicatorCrashes  \* bound on replicator failovers (mesh-wide)
+
+ASSUME
+    /\ NumClusters \in Nat /\ NumClusters >= 2
+    /\ PublishLimit \in Nat /\ PublishLimit >= 1
+    /\ MaxReplicatorCrashes \in Nat
+
+VARIABLES
+    published,   \* [c -> count of messages published at (originating in) c]
+    recvHwm,     \* [dst -> [src -> high watermark of src-origin msgs at dst]]
+    repCursor,   \* [src -> [dst -> in-memory replicator read position]]
+    repAcked,    \* [src -> [dst -> durably persisted replicator position]]
+    duplicated,  \* [dst -> [src -> set of seqnos delivered twice at dst]]
+    crashTimes
+
+vars == <<published, recvHwm, repCursor, repAcked, duplicated, crashTimes>>
+
+Clusters == 1..NumClusters
+
+ZeroMatrix == [a \in Clusters |-> [b \in Clusters |-> 0]]
+
+Init ==
+    /\ published = [c \in Clusters |-> 0]
+    /\ recvHwm = ZeroMatrix
+    /\ repCursor = ZeroMatrix
+    /\ repAcked = ZeroMatrix
+    /\ duplicated = [a \in Clusters |-> [b \in Clusters |-> {}]]
+    /\ crashTimes = 0
+
+(* A producer publishes the next message at cluster c (delivered locally
+   by the broker; replication to the mesh is asynchronous). *)
+Publish ==
+    /\ \E c \in Clusters :
+        /\ published[c] < PublishLimit
+        /\ published' = [published EXCEPT ![c] = published[c] + 1]
+    /\ UNCHANGED <<recvHwm, repCursor, repAcked, duplicated, crashTimes>>
+
+(* The (src -> dst) replicator ships the next local-origin message.  After
+   a failover rewound the cursor below the high watermark, the shipped
+   message is a DUPLICATE at dst. *)
+Replicate ==
+    /\ \E src \in Clusters :
+        \E dst \in Clusters :
+            /\ src # dst
+            /\ repCursor[src][dst] < published[src]
+            /\ repCursor' = [repCursor EXCEPT
+                   ![src] = [repCursor[src] EXCEPT
+                       ![dst] = repCursor[src][dst] + 1]]
+            /\ recvHwm' = [recvHwm EXCEPT
+                   ![dst] = [recvHwm[dst] EXCEPT
+                       ![src] = IF repCursor[src][dst] + 1 > recvHwm[dst][src]
+                                THEN repCursor[src][dst] + 1
+                                ELSE recvHwm[dst][src]]]
+            /\ duplicated' = [duplicated EXCEPT
+                   ![dst] = [duplicated[dst] EXCEPT
+                       ![src] = IF repCursor[src][dst] + 1 <= recvHwm[dst][src]
+                                THEN duplicated[dst][src]
+                                         \cup {repCursor[src][dst] + 1}
+                                ELSE duplicated[dst][src]]]
+    /\ UNCHANGED <<published, repAcked, crashTimes>>
+
+(* The replicator durably acks its read position (lazy, like the
+   compaction cursor persist at compaction.tla:147-151). *)
+PersistCursor ==
+    /\ \E src \in Clusters :
+        \E dst \in Clusters :
+            /\ src # dst
+            /\ repAcked[src][dst] < repCursor[src][dst]
+            /\ repAcked' = [repAcked EXCEPT
+                   ![src] = [repAcked[src] EXCEPT
+                       ![dst] = repCursor[src][dst]]]
+    /\ UNCHANGED <<published, recvHwm, repCursor, duplicated, crashTimes>>
+
+(* Replicator failover: the new instance resumes from the durable cursor,
+   forgetting the unacked read-ahead.  Only rewinding crashes are modeled
+   (a crash with repCursor = repAcked changes nothing observable). *)
+ReplicatorCrash ==
+    /\ crashTimes < MaxReplicatorCrashes
+    /\ \E src \in Clusters :
+        \E dst \in Clusters :
+            /\ src # dst
+            /\ repAcked[src][dst] < repCursor[src][dst]
+            /\ repCursor' = [repCursor EXCEPT
+                   ![src] = [repCursor[src] EXCEPT
+                       ![dst] = repAcked[src][dst]]]
+    /\ crashTimes' = crashTimes + 1
+    /\ UNCHANGED <<published, recvHwm, repAcked, duplicated>>
+
+(* Fully replicated and quiesced. *)
+Done ==
+    /\ \A c \in Clusters : published[c] = PublishLimit
+    /\ \A src \in Clusters : \A dst \in Clusters :
+        src # dst =>
+            /\ repCursor[src][dst] = PublishLimit
+            /\ repAcked[src][dst] = PublishLimit
+
+Terminating ==
+    /\ Done
+    /\ UNCHANGED vars
+
+Next ==
+    \/ Publish
+    \/ Replicate
+    \/ PersistCursor
+    \/ ReplicatorCrash
+    \/ Terminating
+
+Spec == Init /\ [][Next]_vars
+
+-----------------------------------------------------------------------------
+(* Invariants *)
+
+TypeOK ==
+    /\ \A c \in Clusters :
+        /\ published[c] \in 0..PublishLimit
+        /\ recvHwm[c][c] = 0
+        /\ repCursor[c][c] = 0
+        /\ repAcked[c][c] = 0
+        /\ duplicated[c][c] = {}
+    /\ \A src \in Clusters : \A dst \in Clusters :
+        src # dst =>
+            /\ repCursor[src][dst] \in 0..published[src]
+            /\ repAcked[src][dst] \in 0..repCursor[src][dst]
+            /\ recvHwm[dst][src] \in 0..published[src]
+            /\ duplicated[dst][src] \subseteq 1..recvHwm[dst][src]
+    /\ crashTimes \in 0..MaxReplicatorCrashes
+
+(* Per-pair delivery is in order and the watermark is monotone: what dst
+   holds from src is exactly the prefix up to the watermark, and the
+   replicator never reads past what it already delivered. *)
+CursorWithinWatermark ==
+    \A src \in Clusters : \A dst \in Clusters :
+        src # dst => repCursor[src][dst] <= recvHwm[dst][src]
+
+(* A message never reaches a remote cluster before it exists at its
+   origin — origin marking means exactly one hop. *)
+NoPhantomMessages ==
+    \A src \in Clusters : \A dst \in Clusters :
+        src # dst => recvHwm[dst][src] <= published[src]
+
+(* Geo-replication is at-least-once: a replicator failover between read
+   and cursor persist re-ships the gap.  VIOLATED whenever
+   MaxReplicatorCrashes >= 1 — enable to get the duplicate-delivery
+   counterexample (the known anomaly when broker deduplication is not
+   enabled on the remote cluster). *)
+NoDuplicateDelivery ==
+    \A dst \in Clusters : \A src \in Clusters :
+        duplicated[dst][src] = {}
+
+-----------------------------------------------------------------------------
+(* With weak fairness every message reaches every cluster and the mesh
+   quiesces (crashes are bounded). *)
+Termination ==
+    <>Done
+
+=============================================================================
