@@ -33,8 +33,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
+from pulsar_tlaplus_tpu.engine.core import (
+    build_trace,
+    dedup_core,
+    dedup_core_hash,
+)
 from pulsar_tlaplus_tpu.engine.statelog import FileLog, MemoryLog
+from pulsar_tlaplus_tpu.ops import hashtable
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.ref import pyeval
 
@@ -71,7 +76,15 @@ class Checker:
         metrics_path: Optional[str] = None,
         keep_log: bool = False,
         state_log_path: Optional[str] = None,
+        dedup: str = "hash",
     ):
+        if dedup not in ("hash", "sort"):
+            raise ValueError(f"dedup must be 'hash' or 'sort': {dedup}")
+        if dedup == "hash" and visited_cap & (visited_cap - 1):
+            raise ValueError(
+                f"hash dedup needs a power-of-two visited_cap: {visited_cap}"
+            )
+        self.dedup_mode = dedup
         self.model = model
         self.layout = model.layout
         if invariants is None:
@@ -99,19 +112,23 @@ class Checker:
     # jitted steps (cached per visited capacity tier)
     # ------------------------------------------------------------------
 
-    def _dedup_core(self, packed, valid, parent, action, vk1, vk2, vk3, n_visited):
-        return dedup_core(
-            self.model,
-            self.invariant_names,
-            packed,
-            valid,
-            parent,
-            action,
-            vk1,
-            vk2,
-            vk3,
-            n_visited,
-        )
+    def _parse_out(self, out):
+        """Step output -> (packed, parent, action, n_new, vk, viol,
+        n_failed, tail) uniformly across dedup modes."""
+        if self.dedup_mode == "hash":
+            packed, parent, action, n_new = out[:4]
+            vk, viol, n_failed = out[4:8], out[8], int(out[9])
+            tail = out[10:]
+        else:
+            packed, parent, action, n_new = out[:4]
+            vk, viol, n_failed = out[4:7], out[7], 0
+            tail = out[8:]
+        if n_failed:
+            raise RuntimeError(
+                "hash-table probe overflow — raise visited_cap "
+                f"({n_failed} unresolved lanes at capacity {self._cap})"
+            )
+        return packed, parent, action, n_new, vk, viol, tail
 
     def _get_step(self, kind: str):
         key = (kind, self._cap)
@@ -119,20 +136,32 @@ class Checker:
         if fn is not None:
             return fn
         m = self.model
+        is_hash = self.dedup_mode == "hash"
+
+        def core(packed, valid, parent, action, vk, n_visited):
+            if is_hash:
+                return dedup_core_hash(
+                    m, self.invariant_names, packed, valid, parent, action,
+                    *vk,
+                )
+            return dedup_core(
+                m, self.invariant_names, packed, valid, parent, action,
+                *vk, n_visited,
+            )
 
         if kind == "insert":
 
-            def step(packed, valid, vk1, vk2, vk3, n_visited):
+            def step(packed, valid, *rest):
+                vk, n_visited = rest[:-1], rest[-1]
                 n = packed.shape[0]
                 parent = jnp.full((n,), -1, jnp.int32)
                 action = jnp.full((n,), -1, jnp.int32)
-                return self._dedup_core(
-                    packed, valid, parent, action, vk1, vk2, vk3, n_visited
-                )
+                return core(packed, valid, parent, action, vk, n_visited)
 
         else:
 
-            def step(frontier, n, vk1, vk2, vk3, n_visited):
+            def step(frontier, n, *rest):
+                vk, n_visited = rest[:-1], rest[-1]
                 f = frontier.shape[0]
                 row_live = jnp.arange(f, dtype=jnp.int32) < n
                 states = jax.vmap(self.layout.unpack)(frontier)
@@ -143,15 +172,8 @@ class Checker:
                 packed = packed.reshape(fa, self.layout.W)
                 parent = jnp.repeat(jnp.arange(f, dtype=jnp.int32), m.A)
                 action = jnp.tile(jnp.asarray(m.action_ids), f)
-                core = self._dedup_core(
-                    packed,
-                    valid.reshape(fa),
-                    parent,
-                    action,
-                    vk1,
-                    vk2,
-                    vk3,
-                    n_visited,
+                out = core(
+                    packed, valid.reshape(fa), parent, action, vk, n_visited
                 )
                 if self.check_deadlock:
                     stutter = jax.vmap(m.stutter_enabled)(states)
@@ -161,7 +183,7 @@ class Checker:
                     )
                 else:
                     dead_idx = jnp.int32(f)
-                return core + (dead_idx,)
+                return out + (dead_idx,)
 
         fn = jax.jit(step)
         self._jit_cache[key] = fn
@@ -172,21 +194,36 @@ class Checker:
     # ------------------------------------------------------------------
 
     def _grow_visited(self, vk, need: int):
+        """Ensure capacity for ``need`` total entries.
+
+        Sorted mode: columns must hold every entry (cap >= need).  Hash
+        mode: keep load factor <= 1/2 (cap >= 2 * need) and rehash the
+        occupied entries into the bigger table."""
         cap = self._cap
-        while cap < need:
+        target = 2 * need if self.dedup_mode == "hash" else need
+        while cap < target:
             cap *= 4
-        if cap != self._cap:
+        if cap == self._cap:
+            return vk
+        if self.dedup_mode == "hash":
+            vk = hashtable.rehash_into(vk, hashtable.empty_table(cap))
+        else:
             pad = cap - self._cap
             vk = tuple(
                 jnp.concatenate([col, jnp.full((pad,), SENTINEL, jnp.uint32)])
                 for col in vk
             )
-            self._cap = cap
+        self._cap = cap
         return vk
 
     def _config_sig(self) -> str:
         return repr(
-            (self.model.c, self.invariant_names, self.layout.total_bits)
+            (
+                self.model.c,
+                self.invariant_names,
+                self.layout.total_bits,
+                self.dedup_mode,
+            )
         )
 
     def _save_checkpoint(self, rs):
@@ -211,7 +248,9 @@ class Checker:
         np.savez_compressed(
             tmp,
             sig=np.frombuffer(self._config_sig().encode(), dtype=np.uint8),
-            vk0=np.asarray(rs.vk[0]), vk1=np.asarray(rs.vk[1]), vk2=np.asarray(rs.vk[2]),
+            **{
+                f"vk{i}": np.asarray(col) for i, col in enumerate(rs.vk)
+            },
             n_visited=np.int64(rs.n_visited),
             level_sizes=np.asarray(rs.level_sizes, np.int64),
             frontier=rs.frontier,
@@ -242,8 +281,11 @@ class Checker:
                 # carry cumulative wall time across resume so wall_s /
                 # states_per_sec stay meaningful for the whole run
                 rs.t0 = time.time() - float(d["wall_s"])
-            self._cap = len(d["vk0"])
-            rs.vk = tuple(jnp.asarray(d[k]) for k in ("vk0", "vk1", "vk2"))
+            ncols = 4 if self.dedup_mode == "hash" else 3
+            self._cap = len(d["vk0"]) - (1 if self.dedup_mode == "hash" else 0)
+            rs.vk = tuple(
+                jnp.asarray(d[f"vk{i}"]) for i in range(ncols)
+            )
             rs.n_visited = int(d["n_visited"])
             if "log_path" in d:
                 path = d["log_path"].tobytes().decode()
@@ -266,9 +308,12 @@ class Checker:
             )
             self._rewind_metrics(len(rs.level_sizes))
             return self._bfs_loop(rs)
-        rs.vk = tuple(
-            jnp.full((self._cap,), SENTINEL, jnp.uint32) for _ in range(3)
-        )
+        if self.dedup_mode == "hash":
+            rs.vk = hashtable.empty_table(self._cap)
+        else:
+            rs.vk = tuple(
+                jnp.full((self._cap,), SENTINEL, jnp.uint32) for _ in range(3)
+            )
         rs.log = (
             FileLog(self.state_log_path, self.layout.W, fresh=True)
             if self.state_log_path
@@ -285,10 +330,10 @@ class Checker:
 
             print(f"  {msg}", file=sys.stderr, flush=True)
 
-    def _flush_chunk(self, rs, out, frontier_gids, base_row):
+    def _flush_chunk(self, rs, parsed, frontier_gids, base_row):
         """Copy a step's new states to the state log; returns
         (n_new, violation, packed rows of the new states)."""
-        (packed, parent, action, n_new, _nk1, _nk2, _nk3, viol) = out[:8]
+        packed, parent, action, n_new, _vk, viol, _tail = parsed
         n_new = int(n_new)
         np_packed = None
         if n_new:
@@ -401,8 +446,9 @@ class Checker:
             out = self._get_step("insert")(
                 packed, jnp.asarray(valid), *rs.vk, jnp.int32(rs.n_visited)
             )
-            rs.vk = out[4:7]
-            _n_new, violation, _np_new = self._flush_chunk(rs, out, None, 0)
+            parsed = self._parse_out(out)
+            rs.vk = parsed[4]
+            _n_new, violation, _np_new = self._flush_chunk(rs, parsed, None, 0)
             if violation is not None:
                 rs.level_sizes.append(rs.n_total)
                 return self._build_result(rs, violation)
@@ -431,10 +477,11 @@ class Checker:
                     jnp.asarray(chunk), jnp.int32(nc), *rs.vk,
                     jnp.int32(rs.n_visited),
                 )
-                rs.vk = out[4:7]
-                dead_idx = int(out[8])
+                parsed = self._parse_out(out)
+                rs.vk = parsed[4]
+                dead_idx = int(parsed[6][0])
                 n_new, violation, np_new = self._flush_chunk(
-                    rs, out, frontier_gids, start
+                    rs, parsed, frontier_gids, start
                 )
                 if n_new:
                     level_new_packed.append(np_new)

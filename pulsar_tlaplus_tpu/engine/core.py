@@ -86,6 +86,55 @@ def dedup_core(
     return out_packed, out_parent, out_action, n_new, nvk1, nvk2, nvk3, viol
 
 
+def dedup_core_hash(
+    model,
+    invariant_names: Tuple[str, ...],
+    packed: jax.Array,
+    valid: jax.Array,
+    parent: jax.Array,
+    action: jax.Array,
+    t1: jax.Array,
+    t2: jax.Array,
+    t3: jax.Array,
+    occ: jax.Array,
+):
+    """Hash-table dedup of candidate lanes (SURVEY.md §2.2-E3 production
+    path; ``dedup_core`` above is the sorted-columns v0).
+
+    Returns (out_packed, out_parent, out_action, n_new, t1', t2', t3',
+    occ', viol, n_failed): the first ``n_new`` output lanes are the newly
+    discovered states in stable lane order (deterministic — lane order is
+    fixed by the frontier layout), and ``n_failed`` must be checked by the
+    host (nonzero = probe-limit overflow, a hard error).
+    """
+    from pulsar_tlaplus_tpu.ops import hashtable
+
+    layout = model.layout
+    n = packed.shape[0]
+    k1, k2, k3 = dedup.make_keys(packed, layout.total_bits)
+    is_new, t1, t2, t3, occ, n_failed = hashtable.lookup_insert(
+        t1, t2, t3, occ, k1, k2, k3, valid
+    )
+    n_new = jnp.sum(is_new.astype(jnp.int32))
+    perm = partition_perm(is_new)
+    out_packed = packed[perm]
+    out_parent = parent[perm]
+    out_action = action[perm]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    live = lane < n_new
+    # Invariants fused over exactly the new states (SURVEY.md §3.4).
+    states = jax.vmap(layout.unpack)(out_packed)
+    viol_idx = []
+    for name in invariant_names:
+        ok = jax.vmap(model.invariants[name])(states)
+        viol_idx.append(jnp.min(jnp.where(live & ~ok, lane, n)))
+    viol = jnp.stack(viol_idx) if viol_idx else jnp.zeros((0,), jnp.int32)
+    return (
+        out_packed, out_parent, out_action, n_new,
+        t1, t2, t3, occ, viol, n_failed,
+    )
+
+
 def build_trace(model, unpack1, gid: int, log):
     """Reconstruct the counterexample behavior ending at global state ``gid``
     by walking parent pointers in the state log (SURVEY.md §2.2-E7).

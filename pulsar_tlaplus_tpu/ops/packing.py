@@ -1,23 +1,19 @@
 """Packed-state codec: fixed-width bit layouts over uint32 word vectors.
 
-SURVEY.md §7-L0.  Every TLA+ state of the ``compaction`` spec is encoded into
-``W`` uint32 words with a layout derived statically from the model constants.
-The encoding is *canonical* (equal TLA+ states <-> equal words) and *compact*:
+SURVEY.md §7-L0.  Every TLA+ state of a compiled spec is encoded into ``W``
+uint32 words with a layout derived statically from the model constants.
+The encoding is *canonical* (equal TLA+ states <-> equal words) and
+*compact* — see the compaction notes on :class:`Layout` below.
 
-- ``messages`` (compaction.tla:57): ids are positional (``Producer`` appends
-  ``id = Len+1`` at compaction.tla:86; pre-generated Init forces ``id = i`` at
-  compaction.tla:194), so only ``(key, value)`` per position plus a length are
-  stored.
-- ``compactedLedgers`` (compaction.tla:58-59): messages are append-only, so a
-  compacted ledger — a subsequence of a past message prefix — is stored as a
-  per-slot *bitmask over message positions* plus a presence bit.  Distinct
-  masks give distinct sequences (entries carry distinct positional ids), and
-  the mask plus the current ``messages`` array reconstructs the sequence
-  exactly, so the encoding is bijective on reachable states.
-- ``phaseOneResult`` (compaction.tla:64): ``latestForKey`` is a deterministic
-  function of ``messages[1..readPosition]`` (compaction.tla:97-98) and
-  ``messages`` is append-only, so only ``(present, readPosition)`` is stored.
-- ``cursor`` (compaction.tla:60): presence bit + two small ints.
+Implementation note: pack/unpack are **field-vectorized**.  A field of
+``n`` elements of ``width`` bits occupies a contiguous bit range with
+stride ``width``; its word indices and shifts are static numpy arrays, so
+packing is two scatter-adds per field (disjoint bit ranges make OR == ADD)
+and unpacking is two static gathers plus shifts — a few vector ops per
+FIELD rather than several scalar ops per ELEMENT.  At the |Msgs|=64 stress
+config this keeps the traced graph ~50x smaller than an element-unrolled
+codec, which is the difference between seconds and minutes of XLA compile
+time for the fused BFS step.
 
 Canonical-form obligations on writers (kernels must maintain these so that
 packing is injective):
@@ -26,7 +22,7 @@ packing is injective):
 - ``p1_readpos = 0`` whenever ``p1_present = 0``;
 - ``cursor_h = cursor_c = 0`` whenever ``cursor_present = 0``.
 
-No 64-bit integer types are used anywhere (TPU-friendly; jax x64 stays off).
+No 64-bit integer types are used anywhere (TPU-friendly; jax x64 off).
 """
 
 from __future__ import annotations
@@ -36,6 +32,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pulsar_tlaplus_tpu.ref.pyeval import Constants
 
@@ -45,112 +42,78 @@ def bitlen(n: int) -> int:
     return n.bit_length()
 
 
-class StructLayout:
-    """Generic fixed-width bit layout over a user NamedTuple state class.
+class _FieldCodec:
+    """Bit-level codec over an ordered list of (name, n_elems, width)."""
 
-    The model-agnostic counterpart of the hand-tuned compaction ``Layout``
-    (SURVEY.md §7-L0): a compiled spec model declares its state as a
-    NamedTuple of int32 scalars / vectors / matrices plus a ``specs`` map
-    ``field -> (shape, width_bits)`` and gets canonical ``pack``/``unpack``
-    kernels for free.  Fields are packed in NamedTuple field order,
-    row-major within a field.  Widths must be <= 32; every element must be
-    a non-negative integer < 2**width (canonical-form obligation on the
-    model's kernels, as for ``Layout``).
-    """
-
-    def __init__(self, state_cls, specs: dict):
-        self.state_cls = state_cls
-        missing = [f for f in state_cls._fields if f not in specs]
-        if missing:
-            raise ValueError(f"specs missing fields: {missing}")
+    def __init__(self, fields):
         self.fields = []
-        total = 0
-        for name in state_cls._fields:
-            shape, width = specs[name]
-            shape = tuple(shape)
+        base = 0
+        for name, n, width in fields:
             if not 0 <= width <= 32:
                 raise ValueError(f"{name}: width {width} not in 0..32")
-            n_elems = 1
-            for d in shape:
-                n_elems *= d
-            self.fields.append((name, shape, width, n_elems))
-            total += n_elems * width
-        self.total_bits = total
-        self.W = max(1, math.ceil(total / 32))
+            offs = base + np.arange(n, dtype=np.int64) * width
+            widx = (offs // 32).astype(np.int32)
+            shift = (offs % 32).astype(np.uint32)
+            spill = (offs % 32) + width > 32
+            # shift amounts for the spill word; 0 where unused (w <= 32
+            # guarantees shift >= 1 whenever spill, so 32-shift is in 1..31)
+            shr = np.where(spill, 32 - (offs % 32), 0).astype(np.uint32)
+            self.fields.append(
+                (name, n, width, widx, shift, spill, shr)
+            )
+            base += n * width
+        self.total_bits = base
+        self.W = max(1, math.ceil(base / 32))
 
-    def _flat(self, s):
-        """Ordered (scalar u32-castable value, width) stream."""
-        items = []
-        for name, shape, width, n_elems in self.fields:
-            v = getattr(s, name)
-            if shape == ():
-                items.append((v, width))
-            else:
-                flat = jnp.reshape(v, (n_elems,))
-                for i in range(n_elems):
-                    items.append((flat[i], width))
-        return items
-
-    def pack(self, s) -> jax.Array:
-        """One state -> u32[W].  vmap for batches."""
-        words = [jnp.uint32(0)] * self.W
-        pos = 0
-        for val, width in self._flat(s):
-            if width == 0:
+    def pack(self, values_by_field) -> jax.Array:
+        """List of u32-castable [n] arrays (field order) -> u32[W]."""
+        words = jnp.zeros((self.W + 1,), jnp.uint32)  # +1 spill scratch
+        for (name, n, width, widx, shift, spill, shr), v in zip(
+            self.fields, values_by_field
+        ):
+            if width == 0 or n == 0:
                 continue
             mask = (
                 jnp.uint32((1 << width) - 1)
                 if width < 32
                 else jnp.uint32(0xFFFFFFFF)
             )
-            v = val.astype(jnp.uint32) & mask
-            w, off = divmod(pos, 32)
-            words[w] = words[w] | (v << jnp.uint32(off))
-            if off + width > 32:
-                words[w + 1] = words[w + 1] | (v >> jnp.uint32(32 - off))
-            pos += width
-        return jnp.stack(words)
+            v = jnp.asarray(v).reshape(n).astype(jnp.uint32) & mask
+            words = words.at[widx].add(v << shift)
+            if spill.any():
+                hi = jnp.where(spill, v >> shr, jnp.uint32(0))
+                words = words.at[widx + 1].add(hi)
+        return words[: self.W]
 
     def unpack(self, words: jax.Array):
-        """u32[W] -> one state.  vmap for batches."""
-        pos = 0
-
-        def read(width: int) -> jax.Array:
-            nonlocal pos
-            if width == 0:
-                return jnp.int32(0)
-            w, off = divmod(pos, 32)
-            lo = words[w] >> jnp.uint32(off)
-            if off + width > 32:
-                lo = lo | (words[w + 1] << jnp.uint32(32 - off))
+        """u32[W] -> dict name -> i32[n] (flat)."""
+        ext = jnp.concatenate([words, jnp.zeros((1,), jnp.uint32)])
+        out = {}
+        for name, n, width, widx, shift, spill, shr in self.fields:
+            if width == 0 or n == 0:
+                out[name] = jnp.zeros((n,), jnp.int32)
+                continue
             mask = (
                 jnp.uint32((1 << width) - 1)
                 if width < 32
                 else jnp.uint32(0xFFFFFFFF)
             )
-            pos += width
-            return lo & mask
-
-        out = {}
-        for name, shape, width, n_elems in self.fields:
-            if shape == ():
-                out[name] = read(width).astype(jnp.int32)
-            else:
-                elems = [read(width).astype(jnp.int32) for _ in range(n_elems)]
-                arr = (
-                    jnp.stack(elems).reshape(shape)
-                    if n_elems
-                    else jnp.zeros(shape, jnp.int32)
-                )
-                out[name] = arr
-        return self.state_cls(**out)
+            lo = ext[widx] >> shift
+            if spill.any():
+                # low (32-shift) bits came from word widx; the rest were
+                # spilled to word widx+1 starting at bit 0, so they slot
+                # back in at bit position shr = 32-shift
+                hi = jnp.where(spill, ext[widx + 1] << shr, jnp.uint32(0))
+                lo = lo | hi
+            out[name] = (lo & mask).astype(jnp.int32)
+        return out
 
 
 class SState(NamedTuple):
     """Struct-of-scalars state (one TLA+ state; batch via vmap).
 
     Mirrors the 10 VARIABLES of compaction.tla:56-70 under the compressed
-    encoding documented in the module docstring.
+    encoding documented in :class:`Layout`.
     """
 
     length: jax.Array  # i32 scalar: Len(messages), 0..M
@@ -171,7 +134,19 @@ class SState(NamedTuple):
 
 
 class Layout:
-    """Static bit layout for a given ``Constants``; pack/unpack kernels."""
+    """Static bit layout for the compaction spec; pack/unpack kernels.
+
+    Encoding (bit-identical to the original element-stream layout):
+
+    - ``messages`` (compaction.tla:57): ids are positional, so only
+      ``(key, value)`` per position plus a length are stored.
+    - ``compactedLedgers`` (compaction.tla:58-59): a compacted ledger — a
+      subsequence of a past message prefix — is a presence bit plus a
+      *bitmask over message positions* (bit j-1 = position j kept).
+    - ``phaseOneResult`` (compaction.tla:64): ``latestForKey`` is
+      derivable, so only ``(present, readPosition)`` is stored.
+    - ``cursor`` (compaction.tla:60): presence bit + two small ints.
+    """
 
     def __init__(self, c: Constants):
         self.c = c
@@ -185,127 +160,149 @@ class Layout:
         self.cb = bitlen(self.C)
         self.crb = bitlen(c.max_crash_times)
         self.cob = bitlen(c.consume_times_limit) if c.model_consumer else 0
-        self.total_bits = (
-            self.mb
-            + m * (self.kb + self.vb)
-            + self.C * (1 + m)
-            + (1 + self.mb + self.cb)  # cursor
-            + 3  # cstate
-            + (1 + self.mb)  # phaseOneResult
-            + self.mb  # horizon
-            + self.cb  # context
-            + self.crb
-            + self.cob
-        )
-        self.W = max(1, math.ceil(self.total_bits / 32))
-
-    # -- stream construction -------------------------------------------------
-
-    def _items(self, s: SState):
-        """Ordered (scalar, width) stream defining the bit layout."""
-        items = [(s.length, self.mb)]
-        for i in range(self.M):
-            items.append((s.keys[i], self.kb))
-        for i in range(self.M):
-            items.append((s.vals[i], self.vb))
+        fields = [
+            ("length", 1, self.mb),
+            ("keys", m, self.kb),
+            ("vals", m, self.vb),
+        ]
         for cc in range(self.C):
-            items.append((s.led_present[cc], 1))
-            rem = self.M
-            for w in range(self.MW):
-                width = min(32, rem)
-                if width > 0:
-                    items.append((s.led_mask[cc, w], width))
-                rem -= width
-        items.append((s.cursor_present, 1))
-        items.append((s.cursor_h, self.mb))
-        items.append((s.cursor_c, self.cb))
-        items.append((s.cstate, 3))
-        items.append((s.p1_present, 1))
-        items.append((s.p1_readpos, self.mb))
-        items.append((s.horizon, self.mb))
-        items.append((s.context, self.cb))
-        items.append((s.crash, self.crb))
-        items.append((s.consume, self.cob))
-        return items
+            fields.append((f"led_present{cc}", 1, 1))
+            fields.append((f"led_mask{cc}", m, 1))  # == the old word stream
+        fields += [
+            ("cursor_present", 1, 1),
+            ("cursor_h", 1, self.mb),
+            ("cursor_c", 1, self.cb),
+            ("cstate", 1, 3),
+            ("p1_present", 1, 1),
+            ("p1_readpos", 1, self.mb),
+            ("horizon", 1, self.mb),
+            ("context", 1, self.cb),
+            ("crash", 1, self.crb),
+            ("consume", 1, self.cob),
+        ]
+        self._codec = _FieldCodec(fields)
+        self.total_bits = self._codec.total_bits
+        self.W = self._codec.W
+        # static index arrays for mask words <-> bit lanes
+        j = np.arange(m, dtype=np.int32)
+        self._bit_word = j // 32
+        self._bit_shift = jnp.asarray(j % 32, jnp.uint32)
+
+    def _mask_to_bits(self, mask_words: jax.Array) -> jax.Array:
+        """u32[MW] -> u32[M] of 0/1 (bit j-1 = position j kept)."""
+        return (mask_words[self._bit_word] >> self._bit_shift) & jnp.uint32(1)
+
+    def _bits_to_mask(self, bits: jax.Array) -> jax.Array:
+        """u32-castable [M] of 0/1 -> u32[MW]."""
+        words = jnp.zeros((self.MW,), jnp.uint32)
+        return words.at[self._bit_word].add(
+            bits.astype(jnp.uint32) << self._bit_shift
+        )
 
     def pack(self, s: SState) -> jax.Array:
         """One state -> u32[W].  vmap for batches."""
-        words = [jnp.uint32(0)] * self.W
-        pos = 0
-        for val, width in self._items(s):
-            if width == 0:
-                continue
-            mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
-            v = val.astype(jnp.uint32) & mask
-            w, off = divmod(pos, 32)
-            words[w] = words[w] | (v << jnp.uint32(off))
-            if off + width > 32:
-                words[w + 1] = words[w + 1] | (v >> jnp.uint32(32 - off))
-            pos += width
-        return jnp.stack(words)
+        values = [s.length, s.keys, s.vals]
+        for cc in range(self.C):
+            values.append(s.led_present[cc])
+            values.append(self._mask_to_bits(s.led_mask[cc]))
+        values += [
+            s.cursor_present,
+            s.cursor_h,
+            s.cursor_c,
+            s.cstate,
+            s.p1_present,
+            s.p1_readpos,
+            s.horizon,
+            s.context,
+            s.crash,
+            s.consume,
+        ]
+        return self._codec.pack(values)
 
     def unpack(self, words: jax.Array) -> SState:
         """u32[W] -> one state.  vmap for batches."""
-        pos = 0
-
-        def read(width: int) -> jax.Array:
-            nonlocal pos
-            if width == 0:
-                return jnp.int32(0)
-            w, off = divmod(pos, 32)
-            lo = words[w] >> jnp.uint32(off)
-            if off + width > 32:
-                lo = lo | (words[w + 1] << jnp.uint32(32 - off))
-            mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
-            pos += width
-            return lo & mask
-
-        length = read(self.mb).astype(jnp.int32)
-        keys = jnp.stack([read(self.kb).astype(jnp.int32) for _ in range(self.M)]) if self.M else jnp.zeros((0,), jnp.int32)
-        vals = jnp.stack([read(self.vb).astype(jnp.int32) for _ in range(self.M)]) if self.M else jnp.zeros((0,), jnp.int32)
-        led_present = []
-        led_mask = []
-        for _cc in range(self.C):
-            led_present.append(read(1).astype(jnp.int32))
-            rem = self.M
-            mws = []
-            for _w in range(self.MW):
-                width = min(32, rem)
-                mws.append(read(width).astype(jnp.uint32) if width > 0 else jnp.uint32(0))
-                rem -= width
-            led_mask.append(jnp.stack(mws))
-        led_present = (
-            jnp.stack(led_present) if self.C else jnp.zeros((0,), jnp.int32)
-        )
-        led_mask = (
-            jnp.stack(led_mask)
-            if self.C
-            else jnp.zeros((0, self.MW), jnp.uint32)
-        )
-        cursor_present = read(1).astype(jnp.int32)
-        cursor_h = read(self.mb).astype(jnp.int32)
-        cursor_c = read(self.cb).astype(jnp.int32)
-        cstate = read(3).astype(jnp.int32)
-        p1_present = read(1).astype(jnp.int32)
-        p1_readpos = read(self.mb).astype(jnp.int32)
-        horizon = read(self.mb).astype(jnp.int32)
-        context = read(self.cb).astype(jnp.int32)
-        crash = read(self.crb).astype(jnp.int32)
-        consume = read(self.cob).astype(jnp.int32)
+        d = self._codec.unpack(words)
+        sc = lambda name: d[name][0]
+        if self.C:
+            led_present = jnp.stack(
+                [sc(f"led_present{cc}") for cc in range(self.C)]
+            )
+            led_mask = jnp.stack(
+                [
+                    self._bits_to_mask(d[f"led_mask{cc}"])
+                    for cc in range(self.C)
+                ]
+            )
+        else:
+            led_present = jnp.zeros((0,), jnp.int32)
+            led_mask = jnp.zeros((0, self.MW), jnp.uint32)
         return SState(
-            length,
-            keys,
-            vals,
-            led_present,
-            led_mask,
-            cursor_present,
-            cursor_h,
-            cursor_c,
-            cstate,
-            p1_present,
-            p1_readpos,
-            horizon,
-            context,
-            crash,
-            consume,
+            length=sc("length"),
+            keys=d["keys"],
+            vals=d["vals"],
+            led_present=led_present,
+            led_mask=led_mask,
+            cursor_present=sc("cursor_present"),
+            cursor_h=sc("cursor_h"),
+            cursor_c=sc("cursor_c"),
+            cstate=sc("cstate"),
+            p1_present=sc("p1_present"),
+            p1_readpos=sc("p1_readpos"),
+            horizon=sc("horizon"),
+            context=sc("context"),
+            crash=sc("crash"),
+            consume=sc("consume"),
         )
+
+
+class StructLayout:
+    """Generic fixed-width bit layout over a user NamedTuple state class.
+
+    The model-agnostic counterpart of the compaction :class:`Layout`
+    (SURVEY.md §7-L0): a compiled spec model declares its state as a
+    NamedTuple of int32 scalars / vectors / matrices plus a ``specs`` map
+    ``field -> (shape, width_bits)`` and gets canonical ``pack``/``unpack``
+    kernels for free.  Fields are packed in NamedTuple field order,
+    row-major within a field.  Widths must be <= 32; every element must be
+    a non-negative integer < 2**width (canonical-form obligation on the
+    model's kernels, as for ``Layout``).
+    """
+
+    def __init__(self, state_cls, specs: dict):
+        self.state_cls = state_cls
+        missing = [f for f in state_cls._fields if f not in specs]
+        if missing:
+            raise ValueError(f"specs missing fields: {missing}")
+        self.shapes = {}
+        fields = []
+        for name in state_cls._fields:
+            shape, width = specs[name]
+            shape = tuple(shape)
+            n_elems = 1
+            for d in shape:
+                n_elems *= d
+            self.shapes[name] = (shape, n_elems)
+            fields.append((name, n_elems, width))
+        self._codec = _FieldCodec(fields)
+        self.total_bits = self._codec.total_bits
+        self.W = self._codec.W
+
+    def pack(self, s) -> jax.Array:
+        """One state -> u32[W].  vmap for batches."""
+        values = [
+            jnp.reshape(getattr(s, name), (self.shapes[name][1],))
+            for name in self.state_cls._fields
+        ]
+        return self._codec.pack(values)
+
+    def unpack(self, words: jax.Array):
+        """u32[W] -> one state.  vmap for batches."""
+        d = self._codec.unpack(words)
+        out = {}
+        for name in self.state_cls._fields:
+            shape, n_elems = self.shapes[name]
+            v = d[name]
+            out[name] = (
+                v.reshape(shape) if shape != () else v[0]
+            )
+        return self.state_cls(**out)

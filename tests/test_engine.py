@@ -10,16 +10,31 @@ from pulsar_tlaplus_tpu.ref import pyeval as pe
 from tests.helpers import SMALL_CONFIGS
 
 
+@pytest.mark.parametrize("dedup", ["hash", "sort"])
 @pytest.mark.parametrize("name", sorted(set(SMALL_CONFIGS) - {"shipped"}))
-def test_engine_matches_oracle_small(name):
+def test_engine_matches_oracle_small(name, dedup):
     c = SMALL_CONFIGS[name]
     want = pe.check(c, invariants=())
     got = Checker(
-        CompactionModel(c), invariants=(), frontier_chunk=1024, visited_cap=1 << 14
+        CompactionModel(c), invariants=(), frontier_chunk=1024,
+        visited_cap=1 << 14, dedup=dedup,
     ).run()
     assert got.distinct_states == want.distinct_states
     assert got.diameter == want.diameter
     assert got.violation is None and not got.deadlock
+
+
+def test_engine_hash_growth_matches_oracle():
+    """Start the hash table tiny so the run forces several rehash-growth
+    cycles; counts must still be exact."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    got = Checker(
+        CompactionModel(c), invariants=(), frontier_chunk=128,
+        visited_cap=1 << 8, dedup="hash",
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
 
 
 def test_engine_shipped_cfg_published_count():
