@@ -1,0 +1,771 @@
+"""Fully device-resident BFS checker — the round-2 throughput engine.
+
+Motivation (all numbers measured on the v5e chip behind the axon tunnel,
+``scripts/profile_expand2.py`` / ``scripts/profile_prims.py``):
+
+- one host<->device sync costs ~130 ms round-trip and bulk transfers run
+  at ~17-30 MB/s, so ANY per-chunk host involvement dominates wall time
+  (the round-1 engine paid ~5 syncs + MB-scale copies per 8k-state chunk);
+- device sorts are fast (~7 ns/element/operand at 8-16M elements) while
+  random-access gathers cost 15-55 ns/element (latency-bound) — the
+  round-1 hash-table probe loop spent ~1.1 s of every 1.12 s step in them;
+- dispatch is async and free: the host can enqueue work far ahead.
+
+Design (SURVEY.md §2.2 E3/E4/E5/E7 re-architected):
+
+- **Everything lives in HBM**: the visited set (three sorted uint32 key
+  columns), the current/next frontier windows (packed states), and the
+  per-state ``(parent gid, action lane)`` trace log.
+- **Dedup is sort-merge**: concat the sorted visited columns with the
+  candidate keys, one 5-key ``lax.sort``, neighbor-compare — resolving
+  in-batch duplicates AND visited membership in the same pass; a stable
+  flag-sort compacts the merged visited set and the new states.  No
+  random access anywhere on the hot path.
+- **Invariants and deadlock are fused into the expand kernel** (evaluated
+  on candidate lanes, verdicts ride through the sort packed into the
+  payload word), exactly the "fused pmap" shape SURVEY.md §3.4 calls for.
+- The host fetches ONE packed stats vector per group of sub-batches
+  (a single ~130 ms round trip amortized over ~10^6-10^7 candidates) and
+  only dispatches: level loop, budget checks, and buffer growth.
+
+Counterexample traces: the log stores, per state, the parent gid and the
+action LANE that produced it (lanes are deterministic functions), so a
+trace is reconstructed by walking the parent chain on device (one fetch)
+and replaying lanes through the Python oracle on the host — no packed
+states are ever shipped back during the run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
+from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.ref import pyeval
+
+BIG = jnp.int32(2**31 - 1)
+IDX_BITS = 25  # payload: low 25 bits candidate index, high 7 bits verdicts
+
+
+class DeviceChecker:
+    """Level-synchronous BFS on one device with no hot-path host syncs.
+
+    Shapes are static per (visited-tier, frontier-tier): ``G`` frontier
+    states per sub-batch expand into ``NC = G * A`` candidate lanes; the
+    dedup sort is ``VCAP + NC`` wide.  The host grows VCAP/FCAP between
+    levels (geometric tiers, re-jitting per tier via the jit cache).
+    """
+
+    def __init__(
+        self,
+        model,
+        invariants: Optional[Tuple[str, ...]] = None,
+        check_deadlock: bool = True,
+        sub_batch: int = 8192,
+        expand_chunk: Optional[int] = None,
+        visited_cap: int = 1 << 16,
+        frontier_cap: int = 1 << 15,
+        max_states: int = 1 << 26,
+        time_budget_s: Optional[float] = None,
+        progress: bool = False,
+        metrics_path: Optional[str] = None,
+        group: int = 4,
+    ):
+        self.model = model
+        self.layout = model.layout
+        if invariants is None:
+            invariants = getattr(
+                model, "default_invariants", pyeval.DEFAULT_INVARIANTS
+            )
+        self.invariant_names = tuple(invariants)
+        if len(self.invariant_names) > 32 - IDX_BITS:
+            raise ValueError("too many invariants for the payload word")
+        self.check_deadlock = check_deadlock
+        self.A = model.A
+        self.W = self.layout.W
+        self.G = sub_batch
+        self.Fi = expand_chunk or min(sub_batch, 8192)
+        if self.G % self.Fi:
+            raise ValueError("sub_batch must be a multiple of expand_chunk")
+        self.NC = self.G * self.A
+        if self.NC > 1 << IDX_BITS:
+            raise ValueError("sub_batch * A exceeds payload index range")
+        self.VCAP = self._round_cap(visited_cap)
+        self.FCAP = self._round_frontier(frontier_cap)
+        self.SCAP = max_states
+        self.time_budget_s = time_budget_s
+        self.progress = progress
+        self.metrics_path = metrics_path
+        self.group = group
+        self._jits: Dict[tuple, object] = {}
+        self.last_stats: Dict[str, float] = {}
+
+    # -------------------------------------------------------------- util
+
+    def _round_cap(self, c: int) -> int:
+        n = 1 << 10
+        while n < c:
+            n <<= 1
+        return n
+
+    def _round_frontier(self, c: int) -> int:
+        # the append write-window is NC rows, so FCAP >= NC always; also
+        # a multiple of G (NC = G*A) so expand windows never run off the
+        # end of the buffer
+        n = self.NC
+        while n < c:
+            n *= 2
+        return n
+
+    def _log(self, msg: str):
+        if self.progress:
+            import sys
+
+            print(f"  {msg}", file=sys.stderr, flush=True)
+
+    # -------------------------------------------------------- jitted ops
+
+    def _slice_jit(self):
+        """Trivial FCAP-dependent slicer: frontier[FCAP,W], f_off ->
+        [G,W] window.  Keeping this separate means frontier-capacity
+        growth never recompiles the big expand graph."""
+        key = ("slice", self.FCAP)
+        if key in self._jits:
+            return self._jits[key]
+        G, W = self.G, self.W
+
+        def step(frontier, f_off):
+            return lax.dynamic_slice(frontier, (f_off, 0), (G, W))
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _expand_jit(self):
+        """(window[G,W], f_off, n_live, dead_gid, gid_base) ->
+        (ck1, ck2, ck3 [NC], packed [NC,W], payload [NC], dead_gid').
+        ``f_off`` is the window's first row index in the frontier (for
+        liveness masking and deadlock gids); capacity-independent."""
+        key = ("expand",)
+        if key in self._jits:
+            return self._jits[key]
+        m, layout = self.model, self.layout
+        Fi, A, W, G = self.Fi, self.A, self.W, self.G
+        inv_fns = [m.invariants[n] for n in self.invariant_names]
+
+        def chunk(window, f_off, n_live, i):
+            rows = lax.dynamic_slice(window, (i * Fi, 0), (Fi, W))
+            pos = f_off + i * Fi + jnp.arange(Fi, dtype=jnp.int32)
+            live = pos < n_live
+            states = jax.vmap(layout.unpack)(rows)
+            succ, valid = jax.vmap(m.successors)(states)  # [Fi, A]
+            valid = valid & live[:, None]
+            packed = jax.vmap(jax.vmap(layout.pack))(succ)  # [Fi, A, W]
+            fa = Fi * A
+            packedf = packed.reshape(fa, W)
+            k1, k2, k3 = dedup.make_keys(packedf, layout.total_bits)
+            vflat = valid.reshape(fa)
+            k1 = jnp.where(vflat, k1, SENTINEL)
+            k2 = jnp.where(vflat, k2, SENTINEL)
+            k3 = jnp.where(vflat, k3, SENTINEL)
+            vbits = jnp.zeros((Fi, A), jnp.uint32)
+            for b, fn in enumerate(inv_fns):
+                ok = jax.vmap(jax.vmap(fn))(succ)  # [Fi, A]
+                vbits = vbits | ((~ok & valid).astype(jnp.uint32) << b)
+            idx = (i * fa + jnp.arange(fa, dtype=jnp.uint32)).astype(
+                jnp.uint32
+            )
+            payload = idx | (vbits.reshape(fa) << IDX_BITS)
+            if self.check_deadlock:
+                stut = jax.vmap(m.stutter_enabled)(states)
+                dead_rows = live & ~jnp.any(valid, axis=1) & ~stut
+                didx = jnp.min(jnp.where(dead_rows, pos, BIG))
+            else:
+                didx = BIG
+            return k1, k2, k3, packedf, payload, didx
+
+        def step(window, f_off, n_live, dead_gid, gid_base):
+            def body(dead, i):
+                k1, k2, k3, p, pay, didx = chunk(window, f_off, n_live, i)
+                dead = jnp.minimum(
+                    dead,
+                    jnp.where(didx < BIG, gid_base + didx, BIG),
+                )
+                return dead, (k1, k2, k3, p, pay)
+
+            dead, outs = lax.scan(
+                body, dead_gid, jnp.arange(G // Fi, dtype=jnp.int32)
+            )
+            k1, k2, k3, packed, payload = outs
+            nc = G * A
+            return (
+                k1.reshape(nc),
+                k2.reshape(nc),
+                k3.reshape(nc),
+                packed.reshape(nc, W),
+                payload.reshape(nc),
+                dead,
+            )
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _init_jit(self):
+        """(f_off,) -> same contract as expand over NC init candidates."""
+        key = ("init",)
+        if key in self._jits:
+            return self._jits[key]
+        m, layout = self.model, self.layout
+        NC = self.NC
+        inv_fns = [m.invariants[n] for n in self.invariant_names]
+        n_init = min(m.n_initial, (1 << 31) - 1)
+
+        def step(f_off):
+            idx = f_off + jnp.arange(NC, dtype=jnp.int32)
+            states = jax.vmap(m.gen_initial)(idx)
+            packed = jax.vmap(layout.pack)(states)
+            valid = idx < n_init
+            k1, k2, k3 = dedup.make_keys(packed, layout.total_bits)
+            k1 = jnp.where(valid, k1, SENTINEL)
+            k2 = jnp.where(valid, k2, SENTINEL)
+            k3 = jnp.where(valid, k3, SENTINEL)
+            vbits = jnp.zeros((NC,), jnp.uint32)
+            for b, fn in enumerate(inv_fns):
+                ok = jax.vmap(fn)(states)
+                vbits = vbits | ((~ok & valid).astype(jnp.uint32) << b)
+            payload = jnp.arange(NC, dtype=jnp.uint32) | (vbits << IDX_BITS)
+            return k1, k2, k3, packed, payload, BIG
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _dedup_jit(self):
+        """Sort-merge dedup: returns updated visited columns, n_new, and
+        the compacted candidate payloads of the new states in gid order."""
+        key = ("dedup", self.VCAP)
+        if key in self._jits:
+            return self._jits[key]
+        VCAP, NC = self.VCAP, self.NC
+
+        def step(vk1, vk2, vk3, ck1, ck2, ck3, payload):
+            # tag via iota, not concat of constant halves — XLA folds a
+            # constant concat into a materialized 42M-element literal
+            # (tens of seconds of compile + a huge executable upload)
+            tag = (lax.iota(jnp.uint32, VCAP + NC) >= VCAP).astype(
+                jnp.uint32
+            )
+            pay = jnp.concatenate(
+                [jnp.full((VCAP,), 0xFFFFFFFF, jnp.uint32), payload]
+            )
+            c1 = jnp.concatenate([vk1, ck1])
+            c2 = jnp.concatenate([vk2, ck2])
+            c3 = jnp.concatenate([vk3, ck3])
+            s1, s2, s3, st, sp = lax.sort(
+                (c1, c2, c3, tag, pay), num_keys=5, is_stable=False
+            )
+            sent = (s1 == SENTINEL) & (s2 == SENTINEL) & (s3 == SENTINEL)
+            prev_same = jnp.zeros((VCAP + NC,), jnp.bool_)
+            prev_same = prev_same.at[1:].set(
+                (s1[1:] == s1[:-1])
+                & (s2[1:] == s2[:-1])
+                & (s3[1:] == s3[:-1])
+            )
+            new_flag = (st == 1) & ~sent & ~prev_same
+            keep = ~sent & ((st == 0) | new_flag)
+            n_new = jnp.sum(new_flag.astype(jnp.int32))
+            # blank dropped entries to SENTINEL *before* compacting: their
+            # key values must not survive into the visited columns, or the
+            # table silently fills with phantom duplicates
+            kk = (~keep).astype(jnp.uint32)
+            m1 = jnp.where(keep, s1, SENTINEL)
+            m2 = jnp.where(keep, s2, SENTINEL)
+            m3 = jnp.where(keep, s3, SENTINEL)
+            _, v1, v2, v3 = lax.sort(
+                (kk, m1, m2, m3), num_keys=1, is_stable=True
+            )
+            nn = (~new_flag).astype(jnp.uint32)
+            _, new_pay = lax.sort((nn, sp), num_keys=1, is_stable=True)
+            return (
+                v1[:VCAP],
+                v2[:VCAP],
+                v3[:VCAP],
+                n_new,
+                new_pay[:NC],
+            )
+
+        fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._jits[key] = fn
+        return fn
+
+    def _append_core_jit(self, is_init: bool):
+        """Capacity-independent half of the append: gather the new
+        states' packed rows, derive parent gids / action lanes, fold
+        invariant verdicts into the viol vector."""
+        key = ("appcore", is_init)
+        if key in self._jits:
+            return self._jits[key]
+        NC, A = self.NC, self.A
+        n_inv = len(self.invariant_names)
+
+        def step(n_visited, viol, packed, new_pay, n_new, parent_base):
+            lane_idx = jnp.arange(NC, dtype=jnp.int32)
+            live = lane_idx < n_new
+            idxs = (new_pay & jnp.uint32((1 << IDX_BITS) - 1)).astype(
+                jnp.int32
+            )
+            vbits = new_pay >> IDX_BITS
+            rows = packed[jnp.where(live, idxs, 0)]
+            if is_init:
+                par = -1 - (parent_base + idxs)
+                lane = jnp.zeros((NC,), jnp.int32)
+            else:
+                par = parent_base + idxs // A
+                lane = idxs % A
+            par = jnp.where(live, par, 0)
+            lane = jnp.where(live, lane, 0)
+            gids = n_visited + lane_idx
+            vnew = []
+            for b in range(n_inv):
+                vb = live & (((vbits >> b) & 1) == 1)
+                vnew.append(jnp.min(jnp.where(vb, gids, BIG)))
+            viol = jnp.minimum(viol, jnp.stack(vnew)) if n_inv else viol
+            return rows, par, lane, n_visited + n_new, viol
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _write_jit(self):
+        """Trivial capacity-dependent writer: dynamic_update_slice the new
+        rows into the next-frontier window and the par/lane columns into
+        the trace logs.  Compiles in milliseconds, so FCAP growth never
+        recompiles the big graphs."""
+        key = ("write", self.FCAP)
+        if key in self._jits:
+            return self._jits[key]
+
+        def step(nxt, n_next, parent_log, lane_log, n_visited, rows,
+                 par, lane, n_new):
+            nxt = lax.dynamic_update_slice(nxt, rows, (n_next, 0))
+            parent_log = lax.dynamic_update_slice(
+                parent_log, par, (n_visited,)
+            )
+            lane_log = lax.dynamic_update_slice(lane_log, lane, (n_visited,))
+            return nxt, n_next + n_new, parent_log, lane_log
+
+        fn = jax.jit(step, donate_argnums=(0, 2, 3))
+        self._jits[key] = fn
+        return fn
+
+    def _stats_jit(self):
+        key = ("stats",)
+        if key in self._jits:
+            return self._jits[key]
+
+        def step(n_visited, n_next, dead_gid, viol):
+            return jnp.concatenate(
+                [jnp.stack([n_visited, n_next, dead_gid]), viol]
+            )
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _chain_jit(self, max_depth: int):
+        key = ("chain", max_depth)
+        if key in self._jits:
+            return self._jits[key]
+
+        def step(parent_log, lane_log, gid):
+            def body(i, st):
+                g, gids, lanes = st
+                gids = gids.at[i].set(jnp.where(g >= 0, g, BIG))
+                lanes = lanes.at[i].set(
+                    jnp.where(g >= 0, lane_log[jnp.maximum(g, 0)], -1)
+                )
+                nxt = jnp.where(g >= 0, parent_log[jnp.maximum(g, 0)], g)
+                return nxt, gids, lanes
+
+            gids = jnp.full((max_depth,), BIG, jnp.int32)
+            lanes = jnp.full((max_depth,), -1, jnp.int32)
+            g_end, gids, lanes = lax.fori_loop(
+                0, max_depth, body, (gid, gids, lanes)
+            )
+            # g_end = the root's (negative) parent entry: -1 - init_idx
+            return gids, lanes, g_end
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    # ------------------------------------------------------------ growth
+
+    def _grow_visited(self, bufs, need: int):
+        while self.VCAP < need:
+            pad = self.VCAP
+            bufs["vk"] = tuple(
+                jnp.concatenate(
+                    [col, jnp.full((pad,), SENTINEL, jnp.uint32)]
+                )
+                for col in bufs["vk"]
+            )
+            self.VCAP *= 2
+
+    def _grow_frontier(self, bufs, need: int):
+        while self.FCAP < need:
+            pad = self.FCAP
+            z = jnp.zeros((pad, self.W), jnp.uint32)
+            bufs["frontier"] = jnp.concatenate([bufs["frontier"], z])
+            bufs["next"] = jnp.concatenate([bufs["next"], z])
+            self.FCAP *= 2
+
+    # --------------------------------------------------------------- run
+
+    def warmup(self) -> float:
+        """Compile every hot-path jit at the current tiers on dummy data
+        (outside any timed budget); returns the compile wall time."""
+        t0 = time.time()
+        z = jnp.zeros
+        n_inv = len(self.invariant_names)
+
+        def drain(o):
+            # block_until_ready is unreliable on the tunnel backend
+            # (returns at enqueue); a host fetch of one element is a
+            # true completion barrier.  Delete refs right after so the
+            # warmup dummies never coexist in HBM.
+            leaf = jax.tree.leaves(o)[0]
+            np.asarray(jnp.ravel(leaf)[0])
+
+        drain(self._init_jit()(jnp.int32(0)))
+        ck = tuple(
+            jnp.full((self.NC,), SENTINEL, jnp.uint32) for _ in range(3)
+        )
+        vk = tuple(
+            jnp.full((self.VCAP,), SENTINEL, jnp.uint32) for _ in range(3)
+        )
+        drain(self._dedup_jit()(*vk, *ck, z((self.NC,), jnp.uint32)))
+        del vk, ck
+        for is_init in (True, False):
+            drain(
+                self._append_core_jit(is_init)(
+                    jnp.int32(0), jnp.full((n_inv,), int(BIG), jnp.int32),
+                    z((self.NC, self.W), jnp.uint32),
+                    z((self.NC,), jnp.uint32),
+                    jnp.int32(0), jnp.int32(0),
+                )
+            )
+        drain(
+            self._write_jit()(
+                z((self.FCAP, self.W), jnp.uint32), jnp.int32(0),
+                z((self.SCAP + self.NC,), jnp.int32),
+                z((self.SCAP + self.NC,), jnp.int32),
+                jnp.int32(0), z((self.NC, self.W), jnp.uint32),
+                z((self.NC,), jnp.int32), z((self.NC,), jnp.int32),
+                jnp.int32(0),
+            )
+        )
+        frontier = z((self.FCAP, self.W), jnp.uint32)
+        window = self._slice_jit()(frontier, jnp.int32(0))
+        del frontier
+        drain(
+            self._expand_jit()(
+                window, jnp.int32(0), jnp.int32(0), BIG, jnp.int32(0)
+            )
+        )
+        del window
+        drain(
+            self._stats_jit()(
+                jnp.int32(0), jnp.int32(0), BIG,
+                jnp.full((n_inv,), int(BIG), jnp.int32),
+            )
+        )
+        drain(
+            self._chain_jit(4)(
+                z((self.SCAP + self.NC,), jnp.int32),
+                z((self.SCAP + self.NC,), jnp.int32), jnp.int32(-1),
+            )
+        )
+        return time.time() - t0
+
+    def run(self) -> CheckerResult:
+        t0 = time.time()
+        m = self.model
+        n_inv = len(self.invariant_names)
+        # logs get one extra NC-window of slack so the last
+        # dynamic_update_slice before the budget stop never clamps
+        bufs = {
+            "vk": tuple(
+                jnp.full((self.VCAP,), SENTINEL, jnp.uint32)
+                for _ in range(3)
+            ),
+            "frontier": jnp.zeros((self.FCAP, self.W), jnp.uint32),
+            "next": jnp.zeros((self.FCAP, self.W), jnp.uint32),
+            "parent": jnp.zeros((self.SCAP + self.NC,), jnp.int32),
+            "lane": jnp.zeros((self.SCAP + self.NC,), jnp.int32),
+        }
+        st = {
+            "n_visited": jnp.int32(0),
+            "n_next": jnp.int32(0),
+            "dead_gid": BIG,
+            "viol": jnp.full((n_inv,), int(BIG), jnp.int32),
+        }
+        stats_fn = self._stats_jit()
+
+        def fetch():
+            return np.asarray(
+                stats_fn(
+                    st["n_visited"], st["n_next"], st["dead_gid"],
+                    st["viol"],
+                )
+            )
+
+        def dispatch(gen_fn, gen_args, parent_base, is_init):
+            ck1, ck2, ck3, packed, payload, dead = gen_fn(*gen_args)
+            st["dead_gid"] = dead
+            vk1, vk2, vk3, n_new, new_pay = self._dedup_jit()(
+                *bufs["vk"], ck1, ck2, ck3, payload
+            )
+            bufs["vk"] = (vk1, vk2, vk3)
+            rows, par, lane, n_vis2, viol2 = self._append_core_jit(is_init)(
+                st["n_visited"], st["viol"], packed, new_pay, n_new,
+                jnp.int32(parent_base),
+            )
+            (
+                bufs["next"], st["n_next"], bufs["parent"], bufs["lane"],
+            ) = self._write_jit()(
+                bufs["next"], st["n_next"], bufs["parent"], bufs["lane"],
+                st["n_visited"], rows, par, lane, n_new,
+            )
+            st["n_visited"] = n_vis2
+            st["viol"] = viol2
+
+        # ---- level 1: initial states (compaction.tla:188-202) ----
+        n_init = m.n_initial
+        if n_init > self.SCAP:
+            raise ValueError("initial-state set exceeds max_states")
+        self._grow_visited(bufs, n_init + self.NC)
+        self._grow_frontier(bufs, n_init + self.NC)
+        for f_off in range(0, n_init, self.NC):
+            dispatch(self._init_jit(), (jnp.int32(f_off),), f_off, True)
+        stats = fetch()
+        level_sizes = [int(stats[0])]
+
+        # ---- BFS levels ----
+        while True:
+            nv, nf = int(stats[0]), int(stats[1])
+            reason = self._stop_reason(stats, t0)
+            if reason is not None and not (
+                reason.get("truncated") and nf == 0
+            ):
+                return self._result(t0, nv, level_sizes, bufs, **reason)
+            if nf == 0:
+                return self._result(t0, nv, level_sizes, bufs)
+            # swap frontier windows; reset the next-level accumulator
+            bufs["frontier"], bufs["next"] = bufs["next"], bufs["frontier"]
+            n_frontier = nf
+            level_base = nv - nf
+            st["n_next"] = jnp.int32(0)
+            stop = False
+            pending = 0  # sub-batches dispatched since the last fetch
+            try:
+                for f_off in range(0, n_frontier, self.G):
+                    # upper bound on n_visited without a host sync
+                    nv_bound = nv + (pending + 1) * self.NC
+                    need_sync = (
+                        nv_bound + self.NC > self.VCAP
+                        or nv_bound - level_base + self.NC > self.FCAP
+                        or nv_bound > self.SCAP
+                        or pending >= self.group
+                    )
+                    if need_sync:
+                        stats = fetch()
+                        nv, pending = int(stats[0]), 0
+                        if self._stop_reason(stats, t0) is not None:
+                            stop = True
+                            break
+                        # grow only when the NEXT dispatch genuinely
+                        # needs it (growth doubles, so this stays rare)
+                        if nv + self.NC > self.VCAP:
+                            self._grow_visited(bufs, nv + 2 * self.NC)
+                        if nv - level_base + self.NC > self.FCAP:
+                            self._grow_frontier(
+                                bufs, nv - level_base + 2 * self.NC
+                            )
+                    window = self._slice_jit()(
+                        bufs["frontier"], jnp.int32(f_off)
+                    )
+                    dispatch(
+                        self._expand_jit(),
+                        (
+                            window, jnp.int32(f_off),
+                            jnp.int32(n_frontier), st["dead_gid"],
+                            jnp.int32(level_base),
+                        ),
+                        level_base + f_off,
+                        False,
+                    )
+                    pending += 1
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                # HBM exhausted: report what was checked so far (truncated).
+                # Only the small stats scalars are read from here on; the
+                # big buffers may hold donated/poisoned storage.
+                self._log(f"HBM exhausted mid-level: truncating ({e!r:.120})")
+                stop = True
+            try:
+                stats = fetch()
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                stop = True  # keep the last successfully fetched stats
+            nv = int(stats[0])
+            level_count = max(nv - (level_base + n_frontier), 0)
+            if level_count or stop:
+                level_sizes.append(level_count)
+            self._emit_metrics(t0, len(level_sizes), level_count, nv, nf)
+            wall = time.time() - t0
+            self._log(
+                f"level {len(level_sizes)}: +{level_count} "
+                f"(total {nv}, {nv/max(wall,1e-9):.0f} st/s)"
+            )
+            if stop:
+                reason = self._stop_reason(stats, t0) or {"truncated": True}
+                return self._result(t0, nv, level_sizes, bufs, **reason)
+
+    def _over_time(self, t0) -> bool:
+        return (
+            self.time_budget_s is not None
+            and time.time() - t0 > self.time_budget_s
+        )
+
+    def _stop_reason(self, stats, t0) -> Optional[dict]:
+        """``_result`` kwargs if the run must stop, else None.  Priority:
+        invariant violation, deadlock, then state/time budget."""
+        fv = self._first_viol(stats)
+        if fv is not None:
+            return {"viol": fv}
+        if int(stats[2]) < int(BIG):
+            return {"dead_gid": int(stats[2])}
+        if int(stats[0]) >= self.SCAP or self._over_time(t0):
+            return {"truncated": True}
+        return None
+
+    def _first_viol(self, stats) -> Optional[Tuple[str, int]]:
+        """(invariant name, gid) of the lowest-gid violation, or None."""
+        best = None
+        for i, name in enumerate(self.invariant_names):
+            g = int(stats[3 + i])
+            if g < BIG and (best is None or g < best[1]):
+                best = (name, g)
+        return best
+
+    def _emit_metrics(self, t0, level, level_count, nv, nf):
+        if not self.metrics_path:
+            return
+        import json
+
+        wall = time.time() - t0
+        with open(self.metrics_path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "level": level,
+                        "new_states": level_count,
+                        "distinct_states": nv,
+                        "frontier": nf,
+                        "wall_s": round(wall, 3),
+                        "states_per_sec": round(nv / max(wall, 1e-9), 1),
+                        "visited_cap": self.VCAP,
+                    }
+                )
+                + "\n"
+            )
+
+    # ------------------------------------------------------------- trace
+
+    def _trace(self, bufs, gid: int, max_depth: int):
+        """Walk the parent chain on device (one fetch), replay lanes
+        through the oracle on the host (SURVEY.md §2.2-E7)."""
+        gids, lanes, g_end = self._chain_jit(max_depth)(
+            bufs["parent"], bufs["lane"], jnp.int32(gid)
+        )
+        gids = np.asarray(gids)
+        lanes = np.asarray(lanes)
+        g_end = int(np.asarray(g_end))
+        chain = []
+        for i in range(max_depth):
+            if int(gids[i]) == int(BIG):
+                break
+            chain.append((int(gids[i]), int(lanes[i])))
+        assert g_end < 0, "root of parent chain must be an initial state"
+        init_idx = -1 - g_end
+        chain.reverse()
+        s = self._init_pystate(init_idx)
+        states = [s]
+        actions = []
+        names = getattr(self.model, "action_names", pyeval.ACTION_NAMES)
+        for _gid, lane in chain[1:]:
+            s = self._apply_lane(s, lane)
+            states.append(s)
+            actions.append(names[int(self.model.action_ids[lane])])
+        return states, actions
+
+    def _init_pystate(self, idx: int) -> pyeval.State:
+        s = jax.jit(self.model.gen_initial)(jnp.int32(idx))
+        return self.model.to_pystate(jax.device_get(s))
+
+    def _apply_lane(self, ps: pyeval.State, lane: int) -> pyeval.State:
+        m = self.model
+        c = m.c
+        if lane < m.n_producer_lanes:
+            key = lane // (c.num_values + 1)
+            val = lane % (c.num_values + 1)
+            n = len(ps.messages)
+            return ps._replace(messages=ps.messages + ((n + 1, key, val),))
+        aid = int(m.action_ids[lane])
+        for a, t in pyeval.successors(c, ps):
+            if a == aid:
+                return t
+        raise RuntimeError(f"lane {lane} not enabled during replay")
+
+    # ------------------------------------------------------------ result
+
+    def _result(
+        self, t0, nv, level_sizes, bufs,
+        viol: Optional[Tuple[str, int]] = None,
+        dead_gid: Optional[int] = None,
+        truncated: bool = False,
+    ) -> CheckerResult:
+        self.last_bufs = bufs  # debugging/inspection hook
+        wall = time.time() - t0
+        res = CheckerResult(
+            distinct_states=nv,
+            diameter=len(level_sizes),
+            deadlock=dead_gid is not None,
+            wall_s=wall,
+            states_per_sec=nv / max(wall, 1e-9),
+            level_sizes=level_sizes,
+            truncated=truncated,
+        )
+        gid = None
+        if viol is not None:
+            res.violation = viol[0]
+            gid = viol[1]
+        elif dead_gid is not None:
+            res.violation = "Deadlock"
+            gid = dead_gid
+        if gid is not None:
+            res.trace, res.trace_actions = self._trace(
+                bufs, gid, len(level_sizes) + 2
+            )
+        return res

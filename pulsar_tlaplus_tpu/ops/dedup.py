@@ -60,19 +60,23 @@ def murmur3_words(words: jax.Array, seed: int) -> jax.Array:
 def make_keys(packed: jax.Array, total_bits: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """packed u32[N, W] -> 3 x u32[N] dedup key columns.
 
-    Exact (identity) when the state fits in 96 bits, hashed otherwise.
+    Exact (identity) when the state fits in < 96 bits, hashed otherwise.
+    The all-SENTINEL triple is reserved as the empty/invalid marker: it is
+    unreachable in exact mode (padding bits above ``total_bits`` are
+    always zero, and at exactly 96 bits we fall through to hashing), and
+    remapped with negligible collision cost in hashed mode.
     """
     n, w = packed.shape
-    if w <= 3:
+    if w <= 3 and total_bits < 96:
         cols = [packed[:, i] for i in range(w)]
         while len(cols) < 3:
             cols.append(jnp.zeros((n,), jnp.uint32))
         return cols[0], cols[1], cols[2]
-    return (
-        murmur3_words(packed, 0x9E3779B9),
-        murmur3_words(packed, 0x85EBCA6B),
-        murmur3_words(packed, 0xC2B2AE35),
-    )
+    h1 = murmur3_words(packed, 0x9E3779B9)
+    h2 = murmur3_words(packed, 0x85EBCA6B)
+    h3 = murmur3_words(packed, 0xC2B2AE35)
+    all_sent = (h1 == SENTINEL) & (h2 == SENTINEL) & (h3 == SENTINEL)
+    return h1, h2, jnp.where(all_sent, h3 ^ jnp.uint32(1), h3)
 
 
 def _lex_less(

@@ -1,0 +1,93 @@
+"""Validate bucketized-hash primitive costs at sub-batch scale."""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def chain_time(name, f, args, thread, k=6):
+    out = f(*args)
+    _ = jax.block_until_ready(out)
+
+    def run(n):
+        t0 = time.time()
+        a = args
+        o = f(*a)
+        for _ in range(n - 1):
+            a = thread(o, a)
+            o = f(*a)
+        leaf = jax.tree.leaves(o)[0]
+        _ = np.asarray(jnp.ravel(leaf)[0])
+        return time.time() - t0
+
+    t1 = min(run(1) for _ in range(2))
+    tk = min(run(k) for _ in range(2))
+    per = (tk - t1) / (k - 1)
+    print(f"{name:52s} per-call {per*1e3:9.2f} ms")
+    return per
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}")
+
+    ROW = 32  # words per bucket row
+    for nq, nb in ((1 << 20, 1 << 21), (1 << 23, 1 << 22)):
+        flat = jnp.asarray(
+            rng.integers(0, 2**32, nb * ROW, np.uint32))
+        idx = jnp.asarray(rng.integers(0, nb, nq, np.int32))
+
+        def rowgather(flat, idx):
+            g = jax.vmap(
+                lambda i: lax.dynamic_slice(flat, (i * ROW,), (ROW,)))
+            return g(idx)
+
+        chain_time(f"flat-row-gather nq={nq} nb={nb} row{ROW}",
+                   jax.jit(rowgather), (flat, idx),
+                   lambda o, a: (a[0], (a[1] ^ (o[:, 0] & 0)).astype(jnp.int32)))
+
+        tbl2d = flat.reshape(nb, ROW)
+        chain_time(f"2d-row-gather   nq={nq} nb={nb} row{ROW}",
+                   jax.jit(lambda t, i: t[i]), (tbl2d, idx),
+                   lambda o, a: (a[0], (a[1] ^ (o[:, 0] & 0)).astype(jnp.int32)))
+
+    # scatter-set unique at 4M into 128M flat
+    nq, cap = 1 << 22, 1 << 27
+    tbl = jnp.zeros((cap,), jnp.uint32)
+    uni = jnp.asarray(
+        (rng.permutation(cap >> 5)[:nq].astype(np.int64) << 5)
+        .astype(np.int32))
+    vals = jnp.asarray(rng.integers(0, 2**32, nq, np.uint32))
+    chain_time("scatter-set unique 4M into 128M",
+               jax.jit(lambda t, i, v: t.at[i].set(v, unique_indices=True)),
+               (tbl, uni, vals), lambda o, a: (o, a[1], a[2]))
+
+    # big sort at sub-batch scale: 8.7M x (4 keys + 1 payload)
+    n = 8_700_000
+    cols = tuple(jnp.asarray(rng.integers(0, 2**32, n, np.uint32))
+                 for _ in range(5))
+    chain_time("sort4+1 n=8.7M",
+               jax.jit(lambda *c: lax.sort(c, num_keys=4)), cols,
+               lambda o, a: tuple(o), k=4)
+
+    # segmented rank via cummax at 8.7M
+    starts = jnp.asarray(rng.integers(0, 2, n, np.int32))
+    def segrank(starts):
+        i = jnp.arange(n, dtype=jnp.int32)
+        run_start = jnp.where(starts == 1, i, 0)
+        seg = lax.cummax(run_start)
+        return i - seg
+    chain_time("segmented-rank cummax 8.7M", jax.jit(segrank), (starts,),
+               lambda o, a: ((a[0] ^ (o & 0)).astype(jnp.int32),), k=4)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Per-stage timing of the BFS expand step on the bench workload.
+
+Carves the fused expand step into its pipeline stages and times each
+jitted piece separately on the real device, with a visited table at a
+realistic load factor.  Publishes the breakdown the bench report cites.
+
+Usage: python scripts/profile_expand.py [--chunk 8192] [--cap 23]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(name, fn, *args, reps=5):
+    t0 = time.time()
+    out = jax.block_until_ready(fn(*args))
+    compile_s = time.time() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        out = jax.block_until_ready(fn(*args))
+        times.append(time.time() - t0)
+    med = sorted(times)[len(times) // 2]
+    print(f"{name:34s} compile {compile_s:7.2f}s   run {med*1e3:9.2f} ms")
+    return out, med
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("--cap", type=int, default=23, help="log2 visited cap")
+    ap.add_argument("--fill", type=int, default=3_000_000,
+                    help="pre-inserted random keys (sets load factor)")
+    args = ap.parse_args()
+
+    from bench import scaled_config
+    from pulsar_tlaplus_tpu.engine.bfs import Checker
+    from pulsar_tlaplus_tpu.engine.core import partition_perm
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ops import dedup, hashtable
+
+    c = scaled_config()
+    model = CompactionModel(c)
+    layout = model.layout
+    F, A, W = args.chunk, model.A, layout.W
+    FA = F * A
+    cap = 1 << args.cap
+    print(f"device: {jax.devices()[0]}")
+    print(f"F={F} A={A} W={W} FA={FA} cap={cap} fill={args.fill}")
+
+    # -- realistic frontier: run BFS through level 4, take level-4 states --
+    ck = Checker(model, frontier_chunk=4096, visited_cap=1 << 16,
+                 max_states=30_000, keep_log=True)
+    r = ck.run()
+    rs = ck.last_run_state
+    log_mat = rs.log.packed_matrix()
+    n_log = len(log_mat)
+    print(f"BFS seed run: {r.distinct_states} states, {r.diameter} levels")
+    rows = log_mat[np.arange(FA) % n_log][:F]
+    frontier = jnp.asarray(rows)
+    nc = jnp.int32(F)
+
+    # -- visited table at a realistic load factor: random fill --
+    rng = np.random.default_rng(0)
+    t1, t2, t3, occ = hashtable.empty_table(cap)
+    ins = jax.jit(hashtable.lookup_insert)
+    fill_chunk = 1 << 19
+    for start in range(0, args.fill, fill_chunk):
+        ks = [jnp.asarray(rng.integers(0, 2**32, fill_chunk, np.uint32))
+              for _ in range(3)]
+        _, t1, t2, t3, occ, nf = ins(t1, t2, t3, occ, *ks,
+                                     jnp.ones((fill_chunk,), bool))
+        assert int(nf) == 0
+    jax.block_until_ready(occ)
+    print(f"table load: {args.fill / cap:.2f}")
+
+    # ---- stage A: unpack + successors + pack ----
+    def stage_a(frontier, n):
+        f = frontier.shape[0]
+        row_live = jnp.arange(f, dtype=jnp.int32) < n
+        states = jax.vmap(layout.unpack)(frontier)
+        succ, valid = jax.vmap(model.successors)(states)
+        valid = valid & row_live[:, None]
+        packed = jax.vmap(jax.vmap(layout.pack))(succ)
+        return packed.reshape(f * A, W), valid.reshape(f * A)
+
+    (packed, valid), _ = timed("A unpack+successors+pack", jax.jit(stage_a),
+                               frontier, nc)
+
+    # ---- stage B: fingerprint keys ----
+    def stage_b(packed):
+        return dedup.make_keys(packed, layout.total_bits)
+
+    (k1, k2, k3), _ = timed("B make_keys", jax.jit(stage_b), packed)
+
+    # ---- stage C: hash-table lookup/insert ----
+    (is_new, *_rest), _ = timed(
+        "C hashtable lookup_insert", ins, t1, t2, t3, occ, k1, k2, k3, valid)
+
+    # ---- stage D: partition (sort) + gather payload ----
+    def stage_d(is_new, packed):
+        perm = partition_perm(is_new)
+        return packed[perm]
+
+    (out_packed), _ = timed("D partition+gather", jax.jit(stage_d),
+                            is_new, packed)
+
+    # ---- stage E: invariants on all lanes ----
+    def stage_e(out_packed):
+        states = jax.vmap(layout.unpack)(out_packed)
+        oks = [jax.vmap(model.invariants[n])(states)
+               for n in model.default_invariants]
+        return jnp.stack([jnp.min(jnp.where(~ok, jnp.arange(FA), FA))
+                          for ok in oks])
+
+    timed("E invariants(all lanes)", jax.jit(stage_e), out_packed)
+
+    # ---- stage E2: deadlock stutter check ----
+    def stage_e2(frontier):
+        states = jax.vmap(layout.unpack)(frontier)
+        return jax.vmap(model.stutter_enabled)(states)
+
+    timed("E2 stutter check", jax.jit(stage_e2), frontier)
+
+    # ---- full fused expand step (as shipped) ----
+    ck2 = Checker(model, frontier_chunk=F, visited_cap=cap)
+    step = ck2._get_step("expand")
+    out, med = timed("F full expand step", step, frontier, nc,
+                     t1, t2, t3, occ, jnp.int32(args.fill))
+    n_new = int(out[3])
+    print(f"full step: n_new={n_new}, {FA/med:,.0f} lanes/s, "
+          f"{n_new/med:,.0f} new states/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""True device-compute cost per expand stage, measured by chaining.
+
+The axon tunnel has ~130ms host<->device round-trip latency, so a single
+timed dispatch measures RTT, not compute.  Here each stage is dispatched
+``k`` times with a data dependency and fetched once: cost ~= RTT + k * t.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def chain_time(name, f, args, thread, k=10):
+    """f: jitted fn; thread(out, args) -> next args (data dependency)."""
+    out = f(*args)
+    _ = jax.block_until_ready(out)  # compile + settle
+
+    def run(n):
+        t0 = time.time()
+        a = args
+        o = f(*a)
+        for _ in range(n - 1):
+            a = thread(o, a)
+            o = f(*a)
+        leaf = jax.tree.leaves(o)[0]
+        _ = np.asarray(jnp.ravel(leaf)[0])
+        return time.time() - t0
+
+    t1 = min(run(1) for _ in range(3))
+    tk = min(run(k) for _ in range(3))
+    per = (tk - t1) / (k - 1)
+    print(f"{name:34s} 1x {t1*1e3:8.1f} ms   per-call {per*1e3:8.2f} ms")
+    return per
+
+
+def main():
+    from bench import scaled_config
+    from pulsar_tlaplus_tpu.engine.bfs import Checker
+    from pulsar_tlaplus_tpu.engine.core import partition_perm
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ops import dedup, hashtable
+
+    c = scaled_config()
+    model = CompactionModel(c)
+    layout = model.layout
+    F, A, W = 8192, model.A, layout.W
+    FA = F * A
+    cap = 1 << 23
+    print(f"device: {jax.devices()[0]}  F={F} A={A} W={W} cap={cap}")
+
+    ck = Checker(model, frontier_chunk=4096, visited_cap=1 << 16,
+                 max_states=30_000, keep_log=True)
+    ck.run()
+    log_mat = ck.last_run_state.log.packed_matrix()
+    rows = log_mat[np.arange(F) % len(log_mat)]
+    frontier = jnp.asarray(rows)
+    nc = jnp.int32(F)
+
+    rng = np.random.default_rng(0)
+    t1_, t2_, t3_, occ = hashtable.empty_table(cap)
+    ins = jax.jit(hashtable.lookup_insert)
+    for _ in range(6):
+        ks = [jnp.asarray(rng.integers(0, 2**32, 1 << 19, np.uint32))
+              for _ in range(3)]
+        _, t1_, t2_, t3_, occ, _nf = ins(t1_, t2_, t3_, occ, *ks,
+                                         jnp.ones((1 << 19,), bool))
+    jax.block_until_ready(occ)
+    print(f"table load: {6*(1<<19)/cap:.2f}")
+
+    def stage_a(frontier, n):
+        f = frontier.shape[0]
+        row_live = jnp.arange(f, dtype=jnp.int32) < n
+        states = jax.vmap(layout.unpack)(frontier)
+        succ, valid = jax.vmap(model.successors)(states)
+        valid = valid & row_live[:, None]
+        packed = jax.vmap(jax.vmap(layout.pack))(succ)
+        return packed.reshape(f * A, W), valid.reshape(f * A)
+
+    fa = jax.jit(stage_a)
+    chain_time("A unpack+succ+pack", fa, (frontier, nc),
+               lambda o, a: (o[0][:F] ^ jnp.uint32(0), a[1]))
+
+    packed, valid = jax.block_until_ready(fa(frontier, nc))
+
+    fb = jax.jit(lambda p: dedup.make_keys(p, layout.total_bits))
+    chain_time("B make_keys", fb, (packed,),
+               lambda o, a: (a[0] ^ (o[0][:, None] & jnp.uint32(0)),))
+
+    k1, k2, k3 = jax.block_until_ready(fb(packed))
+
+    def ins_thread(o, a):
+        # thread updated table back in; keys xor'd with 0-dependency
+        return (o[1], o[2], o[3], o[4], a[4] ^ (o[0][0].astype(jnp.uint32) & 0),
+                a[5], a[6], a[7])
+
+    fc = jax.jit(lambda t1, t2, t3, occ, k1, k2, k3, v:
+                 hashtable.lookup_insert(t1, t2, t3, occ, k1, k2, k3, v))
+    chain_time("C hashtable lookup_insert", fc,
+               (t1_, t2_, t3_, occ, k1, k2, k3, valid), ins_thread)
+
+    is_new = jax.block_until_ready(fc(t1_, t2_, t3_, occ, k1, k2, k3, valid))[0]
+
+    fd = jax.jit(lambda i, p: p[partition_perm(i)])
+    chain_time("D partition+gather", fd, (is_new, packed),
+               lambda o, a: (a[0], o))
+
+    def stage_e(out_packed):
+        states = jax.vmap(layout.unpack)(out_packed)
+        oks = [jax.vmap(model.invariants[n])(states)
+               for n in model.default_invariants]
+        return jnp.stack([jnp.min(jnp.where(~ok, jnp.arange(FA), FA))
+                          for ok in oks]), out_packed
+
+    fe = jax.jit(stage_e)
+    chain_time("E invariants(all lanes)", fe, (packed,),
+               lambda o, a: (o[1] ^ (o[0][0].astype(jnp.uint32) & 0),))
+
+    step = Checker(model, frontier_chunk=F, visited_cap=cap)._get_step("expand")
+
+    def step_thread(o, a):
+        return (a[0] ^ (o[0][:F] & jnp.uint32(0)), a[1], o[4], o[5], o[6],
+                o[7], a[6])
+
+    chain_time("F full expand step", step,
+               (frontier, nc, t1_, t2_, t3_, occ, jnp.int32(6 * (1 << 19))),
+               step_thread, k=6)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Real (chained) costs of dedup primitive candidates on the TPU tunnel."""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def chain_time(name, f, args, thread, k=8):
+    out = f(*args)
+    _ = jax.block_until_ready(out)
+
+    def run(n):
+        t0 = time.time()
+        a = args
+        o = f(*a)
+        for _ in range(n - 1):
+            a = thread(o, a)
+            o = f(*a)
+        leaf = jax.tree.leaves(o)[0]
+        _ = np.asarray(jnp.ravel(leaf)[0])
+        return time.time() - t0
+
+    t1 = min(run(1) for _ in range(2))
+    tk = min(run(k) for _ in range(2))
+    per = (tk - t1) / (k - 1)
+    print(f"{name:44s} per-call {per*1e3:9.2f} ms")
+    return per
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}")
+
+    # -- sort scaling: 3-key + 1 payload column --
+    for n in (1 << 18, 1 << 21, 1 << 24):
+        cols = tuple(jnp.asarray(rng.integers(0, 2**32, n, np.uint32))
+                     for _ in range(4))
+        f = jax.jit(lambda a, b, c, d: lax.sort((a, b, c, d), num_keys=3))
+        chain_time(f"sort3+1payload n={n}", f, cols,
+                   lambda o, a: (o[0], o[1], o[2], o[3]), k=4)
+
+    # -- gather scaling: nq random gathers from table of size cap --
+    for nq, cap in ((1 << 18, 1 << 23), (1 << 21, 1 << 23), (1 << 24, 1 << 25)):
+        tbl = jnp.asarray(rng.integers(0, 2**32, cap, np.uint32))
+        idx = jnp.asarray(rng.integers(0, cap, nq, np.int32))
+        f = jax.jit(lambda t, i: t[i])
+        chain_time(f"gather nq={nq} cap={cap}", f, (tbl, idx),
+                   lambda o, a: (a[0], (a[1] ^ (o & 0)).astype(jnp.int32)))
+
+    # -- gather ROWS: [nq] row indices from [nbuckets, 32] --
+    nq, nb = 1 << 18, 1 << 20
+    tbl = jnp.asarray(rng.integers(0, 2**32, (nb, 32), np.uint32))
+    idx = jnp.asarray(rng.integers(0, nb, nq, np.int32))
+    f = jax.jit(lambda t, i: t[i])
+    chain_time(f"gather-rows nq={nq} [1M,32]", f, (tbl, idx),
+               lambda o, a: (a[0], (a[1] ^ (o[:, 0] & 0)).astype(jnp.int32)))
+
+    # -- scatter variants: nq updates into cap --
+    nq, cap = 1 << 18, 1 << 23
+    tbl = jnp.zeros((cap,), jnp.uint32)
+    dup_idx = jnp.asarray(rng.integers(0, cap, nq, np.int32))
+    uni_idx = jnp.asarray(
+        rng.choice(cap, nq, replace=False).astype(np.int32))
+    uni_sorted = jnp.sort(uni_idx)
+    vals = jnp.asarray(rng.integers(0, 2**32, nq, np.uint32))
+
+    f = jax.jit(lambda t, i, v: t.at[i].min(v))
+    chain_time("scatter-min dup idx", f, (tbl, dup_idx, vals),
+               lambda o, a: (o, a[1], a[2]))
+    f = jax.jit(lambda t, i, v: t.at[i].set(v, unique_indices=True))
+    chain_time("scatter-set unique", f, (tbl, uni_idx, vals),
+               lambda o, a: (o, a[1], a[2]))
+    f = jax.jit(lambda t, i, v: t.at[i].set(
+        v, unique_indices=True, indices_are_sorted=True))
+    chain_time("scatter-set unique+sorted", f, (tbl, uni_sorted, vals),
+               lambda o, a: (o, a[1], a[2]))
+    f = jax.jit(lambda t, i, v: t.at[i].set(v))
+    chain_time("scatter-set dup-possible", f, (tbl, dup_idx, vals),
+               lambda o, a: (o, a[1], a[2]))
+    # one-hot matmul alternative for small scatter? skip (nq too big)
+
+    # -- searchsorted: nq queries into sorted cap --
+    nq, cap = 1 << 21, 1 << 24
+    vis = jnp.sort(jnp.asarray(rng.integers(0, 2**32, cap, np.uint32)))
+    q = jnp.asarray(rng.integers(0, 2**32, nq, np.uint32))
+    f = jax.jit(lambda v, q: jnp.searchsorted(v, q))
+    chain_time(f"searchsorted nq={nq} cap={cap}", f, (vis, q),
+               lambda o, a: (a[0], a[1] ^ (o.astype(jnp.uint32) & 0)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Differential tests for the device-resident engine (engine/device_bfs.py):
+must match the Python oracle exactly on counts, diameters, verdicts, and
+produce replayable counterexample traces — same bar as the round-1 engine
+(SURVEY.md §4a/§4b), plus growth/truncation behaviors specific to the
+bound-tracking driver."""
+
+import pytest
+
+from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+from tests.helpers import SMALL_CONFIGS, assert_valid_counterexample
+
+
+@pytest.mark.parametrize("name", sorted(set(SMALL_CONFIGS) - {"shipped"}))
+def test_device_engine_matches_oracle_small(name):
+    c = SMALL_CONFIGS[name]
+    want = pe.check(c, invariants=())
+    got = DeviceChecker(
+        CompactionModel(c), invariants=(), sub_batch=256,
+        visited_cap=1 << 12, frontier_cap=1 << 12,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.violation is None and not got.deadlock
+
+
+def test_device_engine_growth_matches_oracle():
+    """Start every capacity tiny so the run forces visited + frontier
+    growth (and the mid-level sync path); counts must still be exact."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    got = DeviceChecker(
+        CompactionModel(c), invariants=(), sub_batch=64,
+        visited_cap=1 << 6, frontier_cap=1 << 6, group=2,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+
+
+def test_device_engine_shipped_cfg_published_count():
+    m = CompactionModel(pe.SHIPPED_CFG)
+    r = DeviceChecker(
+        m, sub_batch=2048, visited_cap=1 << 16, frontier_cap=1 << 15
+    ).run()
+    assert r.distinct_states == 45198  # compaction.tla:23
+    assert r.diameter == 20
+    assert r.violation is None and not r.deadlock
+
+
+def test_device_engine_leak_counterexample():
+    m = CompactionModel(pe.SHIPPED_CFG)
+    r = DeviceChecker(
+        m, invariants=("CompactedLedgerLeak",), sub_batch=2048,
+        visited_cap=1 << 16, frontier_cap=1 << 15,
+    ).run()
+    assert r.violation == "CompactedLedgerLeak"
+    assert r.diameter == 12  # oracle's shortest-trace depth
+    assert len(r.trace) == 12
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r.trace, r.trace_actions, "CompactedLedgerLeak"
+    )
+
+
+def test_device_engine_duplicate_null_key_counterexample():
+    m = CompactionModel(pe.SHIPPED_CFG)
+    r = DeviceChecker(
+        m, invariants=("DuplicateNullKeyMessage",), sub_batch=2048,
+        visited_cap=1 << 16, frontier_cap=1 << 15,
+    ).run()
+    assert r.violation == "DuplicateNullKeyMessage"
+    assert r.diameter == 4
+    assert len(r.trace) == 4
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r.trace, r.trace_actions, "DuplicateNullKeyMessage"
+    )
+
+
+def test_device_engine_max_states_truncation():
+    m = CompactionModel(SMALL_CONFIGS["producer_on"])
+    r = DeviceChecker(
+        m, invariants=(), sub_batch=64, visited_cap=1 << 10,
+        frontier_cap=1 << 10, max_states=40,
+    ).run()
+    assert r.truncated
+    assert r.distinct_states <= 40 + 64 * m.A
