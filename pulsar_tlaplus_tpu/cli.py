@@ -32,7 +32,14 @@ def _report(r, constants, wall: float) -> int:
     3 truncated — a truncated search is NOT a verification result)."""
     from pulsar_tlaplus_tpu.utils.render import render_trace
 
-    if r.violation and r.violation != "Deadlock":
+    if r.violation == "__EvalError__":
+        print(
+            "Error: evaluating the spec on this state is undefined "
+            "(TLC would report an evaluation error here)."
+        )
+        print("The behavior up to this point is:")
+        print(render_trace(r.trace, r.trace_actions, constants))
+    elif r.violation and r.violation != "Deadlock":
         print(f"Error: Invariant {r.violation} is violated.")
         print("The behavior up to this point is:")
         print(render_trace(r.trace, r.trace_actions, constants))
@@ -59,6 +66,71 @@ def _report(r, constants, wall: float) -> int:
     return 0
 
 
+def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
+    """Spec->kernel compiler path (SURVEY.md §2.2-E1): parse + bind,
+    compile Init/Next/invariants to vmapped kernels, run the device BFS
+    engine.  Falls back to the generic interpreter when the spec uses a
+    construct outside the compilable subset."""
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+    from pulsar_tlaplus_tpu.frontend.codegen import CompiledSpec
+    from pulsar_tlaplus_tpu.frontend.codegen_ir import CodegenError
+    from pulsar_tlaplus_tpu.frontend.interp import Spec
+    from pulsar_tlaplus_tpu.frontend.loader import bind_cfg
+    from pulsar_tlaplus_tpu.frontend.parser import parse_file
+
+    if (
+        args.simulate or args.sharded or args.liveness_property
+        or args.checkpoint or args.recover
+    ):
+        return None  # feature needs the registry/interp dispatch below
+    t0 = time.time()
+    try:
+        ast = parse_file(spec_path)
+        consts = bind_cfg(ast, tlc_cfg)
+        interned = consts.pop("__string_interning__", None) or {}
+        spec = Spec(ast, consts)
+    except (ValueError, OSError) as e:
+        sys.exit(f"tpu-tlc: {e}")
+    try:
+        cs = CompiledSpec(spec, invariants=invariants)
+    except CodegenError as e:
+        print(
+            f"tpu-tlc: note: spec->kernel compiler declined ({e}); "
+            "falling back to the generic interpreter"
+        )
+        return _check_interp(args, module, spec_path, tlc_cfg, invariants)
+    print(
+        f"tpu-tlc: checking {module} @ {spec_path} via the spec->kernel "
+        f"compiler (state width {cs.layout.total_bits} bits, {cs.A} "
+        f"successor lanes; invariants: {list(invariants) or 'none'})"
+    )
+    for cname, mapping in interned.items():
+        pairs = ", ".join(f'"{s}" -> {i}' for s, i in mapping.items())
+        print(f"tpu-tlc: note: {cname} strings interned as naturals: {pairs}")
+    ck = DeviceChecker(
+        cs,
+        check_deadlock=not args.nodeadlock,
+        sub_batch=min(args.chunk, 4096),
+        visited_cap=1 << 16,
+        frontier_cap=1 << 14,
+        max_states=args.maxstates,
+        progress=True,
+        metrics_path=args.metrics,
+    )
+    if tlc_cfg.properties:
+        print(
+            "tpu-tlc: WARNING: cfg PROPERTIES "
+            f"{list(tlc_cfg.properties)} are NOT checked on the "
+            "spec->kernel compiler path yet (safety only); liveness "
+            "needs a registry model (-property / cfg PROPERTIES there)"
+        )
+    try:
+        r = ck.run()
+    except ValueError as e:
+        sys.exit(f"tpu-tlc: {e}")
+    return _report(r, None, time.time() - t0)
+
+
 def _check_interp(args, module, spec_path, tlc_cfg, invariants):
     """Generic-interpreter check path: any spec in the supported subset."""
     from pulsar_tlaplus_tpu.engine.interp_check import InterpChecker
@@ -77,6 +149,12 @@ def _check_interp(args, module, spec_path, tlc_cfg, invariants):
         sys.exit(
             "tpu-tlc: -checkpoint/-recover/-metrics are not supported on "
             "the generic-interpreter path yet"
+        )
+    if tlc_cfg.properties:
+        print(
+            "tpu-tlc: WARNING: cfg PROPERTIES "
+            f"{list(tlc_cfg.properties)} are NOT checked on the "
+            "generic-interpreter path (safety only)"
         )
     t0 = time.time()
     try:
@@ -176,6 +254,13 @@ def main(argv=None):
         help="force the generic-interpreter path (host BFS; works for any "
         "spec in the supported TLA+ subset, no compiled model needed)",
     )
+    pc.add_argument(
+        "-compile",
+        dest="force_compile",
+        action="store_true",
+        help="force the spec->kernel compiler path (TPU kernels compiled "
+        "from the .tla, bypassing any hand-written model)",
+    )
     pc.add_argument("-chunk", type=int, default=4096)
     pc.add_argument("-maxstates", type=int, default=200_000_000)
     args = p.parse_args(argv)
@@ -198,8 +283,18 @@ def main(argv=None):
 
     from pulsar_tlaplus_tpu.models import registry
 
-    if args.interp or module not in registry.COMPILED:
+    if args.interp:
         return _check_interp(args, module, spec_path, tlc_cfg, invariants)
+    if args.force_compile or module not in registry.COMPILED:
+        out = _check_compiled_spec(
+            args, module, spec_path, tlc_cfg, invariants
+        )
+        if out is not None:
+            return out
+        if module not in registry.COMPILED:
+            return _check_interp(
+                args, module, spec_path, tlc_cfg, invariants
+            )
 
     try:
         model, constants = registry.COMPILED[module](tlc_cfg)
@@ -293,7 +388,38 @@ def main(argv=None):
         r = ck.run(resume=args.recover) if not args.sharded else ck.run()
     except ValueError as e:
         sys.exit(f"tpu-tlc: {e}")
-    return _report(r, constants, time.time() - t0)
+    rc = _report(r, constants, time.time() - t0)
+    # cfg PROPERTIES are honored automatically after a clean safety pass
+    # (TLC checks temporal properties from the same run)
+    if rc == 0 and not args.sharded and tlc_cfg.properties:
+        from pulsar_tlaplus_tpu.engine.liveness import LivenessChecker
+
+        lck = None
+        for prop in tlc_cfg.properties:
+            try:
+                if lck is None:
+                    lck = LivenessChecker(
+                        model,
+                        goal=prop,
+                        fairness=args.fairness,
+                        frontier_chunk=args.chunk,
+                        max_states=args.maxstates,
+                    )
+                    lres = lck.run()
+                else:
+                    # later properties reuse the same explored state
+                    # space and edge list (one BFS for all PROPERTIES)
+                    lres = lck.run_goal(prop)
+            except (ValueError, RuntimeError) as e:
+                sys.exit(f"tpu-tlc: {e}")
+            verdict = "satisfied" if lres.holds else "VIOLATED"
+            print(
+                f"Temporal property {prop} (fairness={args.fairness}): "
+                f"{verdict} — {lres.reason}"
+            )
+            if not lres.holds:
+                rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -100,6 +100,9 @@ class DeviceChecker:
         self.VCAP = self._round_cap(visited_cap)
         self.FCAP = self._round_frontier(frontier_cap)
         self.SCAP = max_states
+        # trace logs grow geometrically toward SCAP (allocating
+        # max_states-sized logs up front would waste GBs on small runs)
+        self.LCAP = min(self._round_cap(visited_cap), max_states)
         self.time_budget_s = time_budget_s
         self.progress = progress
         self.metrics_path = metrics_path
@@ -349,7 +352,7 @@ class DeviceChecker:
         rows into the next-frontier window and the par/lane columns into
         the trace logs.  Compiles in milliseconds, so FCAP growth never
         recompiles the big graphs."""
-        key = ("write", self.FCAP)
+        key = ("write", self.FCAP, self.LCAP)
         if key in self._jits:
             return self._jits[key]
 
@@ -428,6 +431,18 @@ class DeviceChecker:
             bufs["next"] = jnp.concatenate([bufs["next"], z])
             self.FCAP *= 2
 
+    def _grow_logs(self, bufs, need: int):
+        while self.LCAP < min(need, self.SCAP):
+            new = min(self.LCAP * 2, self.SCAP)
+            pad = new - self.LCAP
+            bufs["parent"] = jnp.concatenate(
+                [bufs["parent"], jnp.zeros((pad,), jnp.int32)]
+            )
+            bufs["lane"] = jnp.concatenate(
+                [bufs["lane"], jnp.zeros((pad,), jnp.int32)]
+            )
+            self.LCAP = new
+
     # --------------------------------------------------------------- run
 
     def warmup(self) -> float:
@@ -466,8 +481,8 @@ class DeviceChecker:
         drain(
             self._write_jit()(
                 z((self.FCAP, self.W), jnp.uint32), jnp.int32(0),
-                z((self.SCAP + self.NC,), jnp.int32),
-                z((self.SCAP + self.NC,), jnp.int32),
+                z((self.LCAP + self.NC,), jnp.int32),
+                z((self.LCAP + self.NC,), jnp.int32),
                 jnp.int32(0), z((self.NC, self.W), jnp.uint32),
                 z((self.NC,), jnp.int32), z((self.NC,), jnp.int32),
                 jnp.int32(0),
@@ -490,8 +505,8 @@ class DeviceChecker:
         )
         drain(
             self._chain_jit(4)(
-                z((self.SCAP + self.NC,), jnp.int32),
-                z((self.SCAP + self.NC,), jnp.int32), jnp.int32(-1),
+                z((self.LCAP + self.NC,), jnp.int32),
+                z((self.LCAP + self.NC,), jnp.int32), jnp.int32(-1),
             )
         )
         return time.time() - t0
@@ -509,8 +524,8 @@ class DeviceChecker:
             ),
             "frontier": jnp.zeros((self.FCAP, self.W), jnp.uint32),
             "next": jnp.zeros((self.FCAP, self.W), jnp.uint32),
-            "parent": jnp.zeros((self.SCAP + self.NC,), jnp.int32),
-            "lane": jnp.zeros((self.SCAP + self.NC,), jnp.int32),
+            "parent": jnp.zeros((self.LCAP + self.NC,), jnp.int32),
+            "lane": jnp.zeros((self.LCAP + self.NC,), jnp.int32),
         }
         st = {
             "n_visited": jnp.int32(0),
@@ -554,6 +569,7 @@ class DeviceChecker:
             raise ValueError("initial-state set exceeds max_states")
         self._grow_visited(bufs, n_init + self.NC)
         self._grow_frontier(bufs, n_init + self.NC)
+        self._grow_logs(bufs, n_init + self.NC)
         for f_off in range(0, n_init, self.NC):
             dispatch(self._init_jit(), (jnp.int32(f_off),), f_off, True)
         stats = fetch()
@@ -583,6 +599,7 @@ class DeviceChecker:
                     need_sync = (
                         nv_bound + self.NC > self.VCAP
                         or nv_bound - level_base + self.NC > self.FCAP
+                        or nv_bound > self.LCAP
                         or nv_bound > self.SCAP
                         or pending >= self.group
                     )
@@ -600,6 +617,8 @@ class DeviceChecker:
                             self._grow_frontier(
                                 bufs, nv - level_base + 2 * self.NC
                             )
+                        if nv > self.LCAP:
+                            self._grow_logs(bufs, nv + 2 * self.NC)
                     window = self._slice_jit()(
                         bufs["frontier"], jnp.int32(f_off)
                     )
@@ -632,12 +651,12 @@ class DeviceChecker:
             level_count = max(nv - (level_base + n_frontier), 0)
             if level_count or stop:
                 level_sizes.append(level_count)
-            self._emit_metrics(t0, len(level_sizes), level_count, nv, nf)
-            wall = time.time() - t0
-            self._log(
-                f"level {len(level_sizes)}: +{level_count} "
-                f"(total {nv}, {nv/max(wall,1e-9):.0f} st/s)"
-            )
+                self._emit_metrics(t0, len(level_sizes), level_count, nv, nf)
+                wall = time.time() - t0
+                self._log(
+                    f"level {len(level_sizes)}: +{level_count} "
+                    f"(total {nv}, {nv/max(wall,1e-9):.0f} st/s)"
+                )
             if stop:
                 reason = self._stop_reason(stats, t0) or {"truncated": True}
                 return self._result(t0, nv, level_sizes, bufs, **reason)
@@ -710,33 +729,9 @@ class DeviceChecker:
         assert g_end < 0, "root of parent chain must be an initial state"
         init_idx = -1 - g_end
         chain.reverse()
-        s = self._init_pystate(init_idx)
-        states = [s]
-        actions = []
-        names = getattr(self.model, "action_names", pyeval.ACTION_NAMES)
-        for _gid, lane in chain[1:]:
-            s = self._apply_lane(s, lane)
-            states.append(s)
-            actions.append(names[int(self.model.action_ids[lane])])
-        return states, actions
-
-    def _init_pystate(self, idx: int) -> pyeval.State:
-        s = jax.jit(self.model.gen_initial)(jnp.int32(idx))
-        return self.model.to_pystate(jax.device_get(s))
-
-    def _apply_lane(self, ps: pyeval.State, lane: int) -> pyeval.State:
-        m = self.model
-        c = m.c
-        if lane < m.n_producer_lanes:
-            key = lane // (c.num_values + 1)
-            val = lane % (c.num_values + 1)
-            n = len(ps.messages)
-            return ps._replace(messages=ps.messages + ((n + 1, key, val),))
-        aid = int(m.action_ids[lane])
-        for a, t in pyeval.successors(c, ps):
-            if a == aid:
-                return t
-        raise RuntimeError(f"lane {lane} not enabled during replay")
+        return self.model.replay_trace(
+            init_idx, [lane for _gid, lane in chain[1:]]
+        )
 
     # ------------------------------------------------------------ result
 

@@ -29,7 +29,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pulsar_tlaplus_tpu.engine.bfs import Checker
 from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 
 
@@ -67,6 +66,8 @@ class LivenessChecker:
         self.goal_fn = goals[goal]
         self.fairness = fairness
         self.F = frontier_chunk
+        from pulsar_tlaplus_tpu.engine.bfs import Checker
+
         self._checker = Checker(
             model,
             invariants=(),
@@ -76,17 +77,97 @@ class LivenessChecker:
             max_states=max_states,
             keep_log=True,
         )
+        self._explored = None  # (packed, n, n_init) — shared across goals
+        self._edge_cache = None  # (src, dst, out_deg) — goal-independent
 
-    def run(self) -> LivenessResult:
-        m = self.model
-        layout = m.layout
+    def _explore(self):
+        """One exhaustive BFS, cached so several properties (cfg
+        PROPERTIES) share the same reachable-set enumeration."""
+        if self._explored is not None:
+            return self._explored
         res = self._checker.run()
         if res.truncated:
             raise RuntimeError("state space exceeded liveness max_states")
         rs = self._checker.last_run_state
         packed = rs.log.packed_matrix()
-        n = len(packed)
-        n_init = rs.level_sizes[0]
+        self._explored = (packed, len(packed), rs.level_sizes[0])
+        return self._explored
+
+    def run_goal(self, goal: str) -> LivenessResult:
+        """Check another named goal over the same explored state space."""
+        goals = getattr(self.model, "liveness_goals", {})
+        if goal not in goals:
+            raise ValueError(f"unknown liveness property: {goal}")
+        self.goal_fn = goals[goal]
+        return self.run()
+
+    def _edges(self, packed, n):
+        """Goal-independent <Next>_vars edge list.  Device sweep computes
+        each state's successor dedup KEYS (12B/edge, not full packed
+        states); gid lookup is one vectorized searchsorted over the
+        sorted key table — no per-(state, lane) Python loop (the round-1
+        bottleneck)."""
+        if self._edge_cache is not None:
+            return self._edge_cache
+        m = self.model
+        layout = m.layout
+        from pulsar_tlaplus_tpu.ops import dedup as dedup_ops
+
+        def _one(w):
+            s = layout.unpack(w)
+            succ, valid = m.successors(s)
+            sp = jax.vmap(layout.pack)(succ)
+            k1, k2, k3 = dedup_ops.make_keys(sp, layout.total_bits)
+            return jnp.stack([k1, k2, k3], axis=-1), valid
+
+        succ_fn = jax.jit(jax.vmap(_one))
+
+        def _void(keys3: np.ndarray) -> np.ndarray:
+            """[n, 3] u32 -> void12 rows (memcmp order; consistent on
+            both sides of the searchsorted)."""
+            a = np.ascontiguousarray(keys3.astype(np.uint32))
+            return a.view([("v", "V12")]).ravel()
+
+        k1, k2, k3 = (
+            np.asarray(x)
+            for x in dedup_ops.make_keys(
+                jnp.asarray(packed), layout.total_bits
+            )
+        )
+        state_keys = _void(np.stack([k1, k2, k3], axis=-1))
+        order = np.argsort(state_keys)
+        sorted_keys = state_keys[order]
+        src_parts, dst_parts = [], []
+        for start in range(0, n, self.F):
+            chunk = packed[start : start + self.F]
+            nc = len(chunk)
+            if nc < self.F:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((self.F - nc, layout.W), np.uint32)]
+                )
+            sk, sv = succ_fn(jnp.asarray(chunk))
+            sk = np.asarray(sk)[:nc]  # [nc, A, 3]
+            sv = np.asarray(sv)[:nc]  # [nc, A]
+            flat = _void(sk.reshape(-1, 3))
+            pos = np.searchsorted(sorted_keys, flat)
+            pos = np.clip(pos, 0, n - 1)
+            v = order[pos]
+            ok = (sorted_keys[pos] == flat) & sv.reshape(-1)
+            u = np.repeat(np.arange(start, start + nc, dtype=np.int64), m.A)
+            keep_e = ok & (v != u)  # drop stutters: not <Next>_vars
+            src_parts.append(u[keep_e])
+            dst_parts.append(v[keep_e].astype(np.int64))
+        src = np.concatenate(src_parts) if src_parts else np.zeros(0, np.int64)
+        dst = np.concatenate(dst_parts) if dst_parts else np.zeros(0, np.int64)
+        out_deg = np.zeros((n,), np.int64)
+        np.add.at(out_deg, src, 1)
+        self._edge_cache = (src, dst, out_deg)
+        return self._edge_cache
+
+    def run(self) -> LivenessResult:
+        m = self.model
+        layout = m.layout
+        packed, n, n_init = self._explore()
 
         goal_fn = jax.jit(jax.vmap(lambda w: self.goal_fn(layout.unpack(w))))
         goal = np.zeros((n,), bool)
@@ -115,38 +196,9 @@ class LivenessChecker:
                 True, "every initial state satisfies the goal", n
             )
 
-        # ---- wf_next: materialize the edge list (one more device sweep) ----
-        def _one(w):
-            s = layout.unpack(w)
-            succ, valid = m.successors(s)
-            return jax.vmap(layout.pack)(succ), valid
+        # ---- wf_next: materialize the edge list (cached across goals) ----
+        src, dst, out_deg = self._edges(packed, n)
 
-        succ_fn = jax.jit(jax.vmap(_one))
-        gid_of = {packed[i].tobytes(): i for i in range(n)}
-        src_list, dst_list = [], []
-        out_deg = np.zeros((n,), np.int64)
-        for start in range(0, n, self.F):
-            chunk = packed[start : start + self.F]
-            nc = len(chunk)
-            if nc < self.F:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((self.F - nc, layout.W), np.uint32)]
-                )
-            sp, sv = succ_fn(jnp.asarray(chunk))
-            sp = np.asarray(sp)  # [F, A, W]
-            sv = np.asarray(sv)  # [F, A]
-            for i in range(nc):
-                u = start + i
-                for lane in range(m.A):
-                    if sv[i, lane]:
-                        v = gid_of[sp[i, lane].tobytes()]
-                        if v == u:
-                            continue  # stuttering step, not <Next>_vars
-                        src_list.append(u)
-                        dst_list.append(v)
-                        out_deg[u] += 1
-        src = np.asarray(src_list, np.int64)
-        dst = np.asarray(dst_list, np.int64)
 
         # restrict to not-goal -> not-goal edges; reach R from not-goal inits
         keep = ~goal[src] & ~goal[dst]
@@ -202,18 +254,26 @@ class LivenessChecker:
         cyc_nodes = np.nonzero(alive)[0]
         if len(cyc_nodes):
             # Kahn peeling (in-degree) can leave acyclic tail nodes that
-            # dangle off a cycle; peel zero-OUT-degree nodes too so that
-            # every surviving node has an alive successor, making the
-            # cycle-recovery walk total.
-            changed = True
-            while changed:
-                changed = False
-                for u in np.nonzero(alive)[0]:
-                    if not any(
-                        alive[int(v)] for v in rdst[starts[u] : starts[u + 1]]
-                    ):
-                        alive[u] = False
-                        changed = True
+            # dangle off a cycle; one backward Kahn pass on OUT-degree
+            # (linear, via the reverse adjacency) removes them so every
+            # surviving node has an alive successor and the
+            # cycle-recovery walk is total.
+            both = alive[rsrc] & alive[rdst]
+            odeg = np.zeros((n,), np.int64)
+            np.add.at(odeg, rsrc[both], 1)
+            rorder = np.argsort(rdst, kind="stable")
+            bsrc, bdst = rsrc[rorder], rdst[rorder]
+            bstarts = np.searchsorted(bdst, np.arange(n + 1))
+            queue = [int(u) for u in cyc_nodes if odeg[u] == 0]
+            while queue:
+                u = queue.pop()
+                alive[u] = False
+                for p in bsrc[bstarts[u] : bstarts[u + 1]]:
+                    p = int(p)
+                    if alive[p]:
+                        odeg[p] -= 1
+                        if odeg[p] == 0:
+                            queue.append(p)
             cyc_nodes = np.nonzero(alive)[0]
         if len(cyc_nodes):
             # recover one cycle: walk alive-successors until a repeat

@@ -120,14 +120,6 @@ Desc = object
 ZSEQ = DSeq(None, 0)  # the empty sequence <<>> before an elem desc is known
 
 
-def _is_int_run(keys) -> bool:
-    return (
-        len(keys) > 0
-        and all(isinstance(k, int) and not isinstance(k, bool) for k in keys)
-        and tuple(keys) == tuple(range(1, len(keys) + 1))
-    )
-
-
 def desc_of_value(v) -> Desc:
     """Exact descriptor of one interpreter canon value."""
     if isinstance(v, bool):
@@ -205,15 +197,17 @@ def join(a: Desc, b: Desc) -> Desc:
             if a.nil != b.nil:
                 raise CodegenError(f"option nil mismatch: {a.nil} vs {b.nil}")
             return DOpt(join(a.inner, b.inner), a.nil)
-    # mixed kinds
+    # mixed kinds: a single-atom enum (Nil-like) unions with any
+    # non-enum/bool kind as an option type (ints included — e.g. the
+    # reference's ``IF maxledgerId = 1 THEN Nil ELSE maxledgerId - 1``)
     na, nb = _is_nil_enum(a), _is_nil_enum(b)
-    if na is not None and not isinstance(b, (DEnum, DBool, DInt)):
+    if na is not None and not isinstance(b, (DEnum, DBool)):
         if isinstance(b, DOpt):
             if b.nil != na:
                 raise CodegenError(f"option nil mismatch: {b.nil} vs {na}")
             return b
         return DOpt(b, na)
-    if nb is not None and not isinstance(a, (DEnum, DBool, DInt)):
+    if nb is not None and not isinstance(a, (DEnum, DBool)):
         return join(b, a)
     if isinstance(a, DOpt) and not isinstance(b, DOpt):
         return DOpt(join(a.inner, b), a.nil)
@@ -388,6 +382,8 @@ def coerce(jv: JV, d: Desc) -> JV:
         return JV(d, jv.data)
     a = jv.data
     if isinstance(d, DInt) and isinstance(s, DInt):
+        if s.lo < d.lo or s.hi > d.hi:
+            raise CodegenError(f"cannot narrow int {s} -> {d}")
         return JV(d, a)
     if isinstance(d, DBool) and isinstance(s, DBool):
         return JV(d, a)
@@ -419,6 +415,10 @@ def coerce(jv: JV, d: Desc) -> JV:
         if isinstance(s, DSeq):
             return coerce(_seq_to_fun_jv(JV(s, a)), d)
         if isinstance(s, DFun):
+            if s.partial and not d.partial:
+                raise CodegenError(
+                    f"cannot coerce partial fun {s} to total {d}"
+                )
             pres, vd = a
             vjv = coerce(JV(s.val, vd), d.val)
             vd = vjv.data
@@ -463,6 +463,10 @@ def coerce(jv: JV, d: Desc) -> JV:
         return JV(d, _scatter_last(out, jnp.asarray(m), a))
     if isinstance(d, DOpt):
         if isinstance(s, DOpt):
+            if s.nil != d.nil:
+                raise CodegenError(
+                    f"option nil mismatch: {s.nil} vs {d.nil}"
+                )
             inner = coerce(JV(s.inner, a[1]), d.inner)
             return JV(d, (a[0], inner.data))
         nil = _is_nil_enum(s)
@@ -727,9 +731,7 @@ class DescCodec:
     def unpack(self, words: jax.Array) -> Dict:
         flat = self._codec.unpack(words)
         out = {}
-        it = iter(self._codec.fields)
         arrays = [flat[f[0]] for f in self._codec.fields]
-        del it
         pos = 0
         for v, d in self.var_descs.items():
             n_leaves: List = []
@@ -784,6 +786,11 @@ def encode_value(d: Desc, v) -> object:
             m = dict(v.items)
         else:
             raise CodegenError(f"expected function, got {v!r}")
+        extra = set(m) - set(d.keys)
+        if extra:
+            raise CodegenError(
+                f"function keys outside descriptor universe: {extra}"
+            )
         pres = np.asarray([k in m for k in d.keys], np.bool_)
         if not d.partial and not pres.all():
             raise CodegenError(f"total fun missing keys: {v!r}")
@@ -807,7 +814,14 @@ def encode_value(d: Desc, v) -> object:
 
 
 def encode_value_zero(d: Desc):
-    """Canonical zero data for one (unbatched) value of descriptor d."""
+    """Canonical zero data for one (unbatched) value of descriptor d.
+
+    Note: DInt zeros are ``d.lo`` so they pack to 0 through DescCodec
+    (which always canonicalizes before packing).  ``canonicalize``
+    itself zeroes dead slots to raw 0, which packs to ``-lo mod 2^w``;
+    the two agree whenever ``lo == 0`` and otherwise only the
+    canonicalized form ever reaches ``pack`` — do not compare
+    host-encoded and device-canonicalized data trees directly."""
     if isinstance(d, DInt):
         return np.int32(d.lo)  # packs to 0
     if isinstance(d, DBool):

@@ -459,6 +459,37 @@ class CompactionModel:
         return {"Termination": self.termination_goal}
 
     # ------------------------------------------------------------------
+    # trace replay (device engine E7 protocol): action lanes are
+    # deterministic functions, so a (init_idx, lane list) chain replays
+    # through the Python oracle without shipping packed states back
+    # ------------------------------------------------------------------
+
+    def replay_trace(self, init_idx: int, lanes) -> Tuple[list, list]:
+        """(pyeval.State list, action names) along a lane chain."""
+        s0 = jax.jit(self.gen_initial)(jnp.int32(init_idx))
+        ps = self.to_pystate(jax.device_get(s0))
+        states = [ps]
+        actions = []
+        for lane in lanes:
+            ps = self._apply_lane_py(ps, int(lane))
+            states.append(ps)
+            actions.append(pyeval.ACTION_NAMES[int(self.action_ids[lane])])
+        return states, actions
+
+    def _apply_lane_py(self, ps: pyeval.State, lane: int) -> pyeval.State:
+        c = self.c
+        if lane < self.n_producer_lanes:
+            key = lane // (c.num_values + 1)
+            val = lane % (c.num_values + 1)
+            n = len(ps.messages)
+            return ps._replace(messages=ps.messages + ((n + 1, key, val),))
+        aid = int(self.action_ids[lane])
+        for a, t in pyeval.successors(c, ps):
+            if a == aid:
+                return t
+        raise RuntimeError(f"lane {lane} not enabled during replay")
+
+    # ------------------------------------------------------------------
     # host-side conversions to/from the oracle's structural states
     # ------------------------------------------------------------------
 

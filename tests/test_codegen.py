@@ -1,0 +1,146 @@
+"""Differential tests for the spec->kernel compiler (frontend/codegen.py):
+the compiled path must reproduce the interpreter's (and oracle's) state
+counts, diameters, verdicts, and traces — on the real reference spec
+(/root/reference/compaction.tla) WITHOUT the hand-written model, and on
+the original specs in specs/."""
+
+import pytest
+
+from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+from pulsar_tlaplus_tpu.frontend import interp as I
+from pulsar_tlaplus_tpu.frontend.codegen import CompiledSpec
+from pulsar_tlaplus_tpu.frontend.loader import compaction_constants
+from pulsar_tlaplus_tpu.frontend.parser import parse_file
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+from tests.helpers import SMALL_CONFIGS
+
+REFERENCE_TLA = "/root/reference/compaction.tla"
+
+
+@pytest.fixture(scope="module")
+def module():
+    return parse_file(REFERENCE_TLA)
+
+
+def _spec(module, c):
+    return I.Spec(module, compaction_constants(c))
+
+
+def _check(spec, invariants=(), **kw):
+    cs = CompiledSpec(spec, invariants=invariants)
+    kw.setdefault("sub_batch", 256)
+    kw.setdefault("visited_cap", 1 << 12)
+    kw.setdefault("frontier_cap", 1 << 12)
+    return DeviceChecker(cs, **kw).run(), cs
+
+
+@pytest.mark.parametrize(
+    "name", ["producer_on", "two_crashes", "no_retain"]
+)
+def test_compiled_matches_oracle_small(module, name):
+    c = SMALL_CONFIGS[name]
+    want = pe.check(c, invariants=())
+    got, _cs = _check(_spec(module, c))
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.violation is None and not got.deadlock
+
+
+def test_compiled_shipped_cfg_published_count(module):
+    """45,198 distinct states (compaction.tla:23) on the compiled path,
+    straight from the reference .tla text — no hand-written model."""
+    got, cs = _check(
+        _spec(module, pe.SHIPPED_CFG),
+        sub_batch=1024, visited_cap=1 << 16, frontier_cap=1 << 14,
+    )
+    assert got.distinct_states == 45198
+    assert got.diameter == 20
+    assert got.violation is None and not got.deadlock
+
+
+def test_compiled_leak_counterexample(module):
+    got, cs = _check(
+        _spec(module, pe.SHIPPED_CFG),
+        invariants=("CompactedLedgerLeak",),
+        sub_batch=1024, visited_cap=1 << 16, frontier_cap=1 << 14,
+    )
+    assert got.violation == "CompactedLedgerLeak"
+    assert got.diameter == 12
+    assert len(got.trace) == 12
+    # rendered trace: every step labeled with a real action
+    assert all(isinstance(a, str) and a for a in got.trace_actions)
+
+
+def test_compiled_duplicate_null_key_counterexample(module):
+    got, _cs = _check(
+        _spec(module, pe.SHIPPED_CFG),
+        invariants=("DuplicateNullKeyMessage",),
+        sub_batch=1024, visited_cap=1 << 16, frontier_cap=1 << 14,
+    )
+    assert got.violation == "DuplicateNullKeyMessage"
+    assert got.diameter == 4
+    assert len(got.trace) == 4
+
+
+def test_compiled_lane_order_matches_interpreter(module):
+    """Per-state successor sets must match the interpreter exactly
+    (in-set equality; lanes are a superset ordering of enabled succs)."""
+    c = SMALL_CONFIGS["producer_on"]
+    spec = _spec(module, c)
+    I.install_defs(spec)
+    cs = CompiledSpec(spec)
+    import jax
+    import numpy as np
+
+    step = jax.jit(cs.successors)
+    # walk a few BFS levels with the interpreter, compare per state
+    frontier = spec.initial_states()
+    seen = set(frontier)
+    for _lvl in range(4):
+        nxt = []
+        for s in frontier[:40]:
+            want = {t for _a, t in spec.successors(s)}
+            enc = {
+                v: jax.tree_util.tree_map(
+                    jax.numpy.asarray,
+                    __import__(
+                        "pulsar_tlaplus_tpu.frontend.codegen_ir",
+                        fromlist=["encode_value"],
+                    ).encode_value(cs.var_descs[v], val),
+                )
+                for v, val in zip(spec.vars, s)
+            }
+            enc["__err__"] = jax.numpy.bool_(False)
+            succ, valid = step(enc)
+            got = set()
+            for k in range(cs.A):
+                if not bool(np.asarray(valid)[k]):
+                    continue
+                one = jax.tree_util.tree_map(lambda x: x[k], succ)
+                dec = cs.decode_state(one)
+                assert not bool(np.asarray(one["__err__"])), dec
+                got.add(tuple(dec[v] for v in spec.vars))
+            assert got == want, f"successor mismatch at {s}"
+            for t in want:
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+
+
+def test_compiled_subscription_spec():
+    """A second, structurally different spec compiles and matches its
+    interpreter counts (specs/subscription.tla)."""
+    from pulsar_tlaplus_tpu.frontend.loader import bind_cfg
+    from pulsar_tlaplus_tpu.utils.cfg import parse_cfg
+
+    mod = parse_file("/root/repo/specs/subscription.tla")
+    cfg = parse_cfg(open("/root/repo/specs/subscription.cfg").read())
+    consts = bind_cfg(mod, cfg)
+    spec = I.Spec(mod, consts)
+    from pulsar_tlaplus_tpu.engine.interp_check import InterpChecker
+
+    want = InterpChecker(spec, invariants=()).run()
+    got, _cs = _check(spec)
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
