@@ -206,6 +206,20 @@ def main(argv=None):
         help="run mesh-sharded over N devices",
     )
     pc.add_argument(
+        "-slices",
+        type=int,
+        default=1,
+        metavar="S",
+        help="with -sharded: arrange the N devices as S slices (2-D "
+        "dcn x ici mesh with hierarchical fingerprint routing)",
+    )
+    pc.add_argument(
+        "-sharded-dedup",
+        choices=["sort", "hash"],
+        default="sort",
+        help="sharded visited-set structure (default: sorted columns)",
+    )
+    pc.add_argument(
         "-invariant",
         action="append",
         default=None,
@@ -249,6 +263,12 @@ def main(argv=None):
         "-cpu", action="store_true", help="force the CPU backend"
     )
     pc.add_argument(
+        "-profile",
+        metavar="DIR",
+        help="capture a JAX profiler trace of the whole check into DIR "
+        "(inspect with TensorBoard / Perfetto)",
+    )
+    pc.add_argument(
         "-interp",
         action="store_true",
         help="force the generic-interpreter path (host BFS; works for any "
@@ -269,6 +289,13 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.profile:
+        import atexit
+
+        import jax
+
+        jax.profiler.start_trace(args.profile)
+        atexit.register(jax.profiler.stop_trace)
 
     from pulsar_tlaplus_tpu.utils import cfg as cfgmod
     from pulsar_tlaplus_tpu.utils.render import render_trace
@@ -349,13 +376,15 @@ def main(argv=None):
         )
         return 1 if sres.violation else 0
     if args.sharded:
-        if args.recover or args.checkpoint or args.metrics:
-            sys.exit(
-                "tpu-tlc: -checkpoint/-recover/-metrics are not supported "
-                "with -sharded yet"
-            )
         from pulsar_tlaplus_tpu.engine.sharded import ShardedChecker
 
+        mesh = None
+        if args.slices > 1:
+            from pulsar_tlaplus_tpu.parallel.mesh import make_mesh2d
+
+            if args.sharded % args.slices:
+                sys.exit("tpu-tlc: -sharded must be divisible by -slices")
+            mesh = make_mesh2d(args.slices, args.sharded // args.slices)
         ck = ShardedChecker(
             model,
             n_devices=args.sharded,
@@ -363,6 +392,10 @@ def main(argv=None):
             check_deadlock=not args.nodeadlock,
             frontier_chunk=args.chunk,
             max_states=args.maxstates,
+            mesh=mesh,
+            dedup_mode=args.sharded_dedup,
+            metrics_path=args.metrics,
+            checkpoint_path=args.checkpoint,
         )
     else:
         from pulsar_tlaplus_tpu.engine.bfs import Checker
@@ -385,7 +418,7 @@ def main(argv=None):
             f"(got: {args.checkpoint})"
         )
     try:
-        r = ck.run(resume=args.recover) if not args.sharded else ck.run()
+        r = ck.run(resume=args.recover)
     except ValueError as e:
         sys.exit(f"tpu-tlc: {e}")
     rc = _report(r, constants, time.time() - t0)

@@ -535,13 +535,18 @@ class DeviceChecker:
         }
         stats_fn = self._stats_jit()
 
+        self._host_wait_s = 0.0
+
         def fetch():
-            return np.asarray(
+            tf = time.time()
+            out = np.asarray(
                 stats_fn(
                     st["n_visited"], st["n_next"], st["dead_gid"],
                     st["viol"],
                 )
             )
+            self._host_wait_s += time.time() - tf
+            return out
 
         def dispatch(gen_fn, gen_args, parent_base, is_init):
             ck1, ck2, ck3, packed, payload, dead = gen_fn(*gen_args)
@@ -703,6 +708,12 @@ class DeviceChecker:
                         "distinct_states": nv,
                         "frontier": nf,
                         "wall_s": round(wall, 3),
+                        # cumulative time the host spent blocked on stats
+                        # fetches (everything else is device kernel time
+                        # plus free async dispatch)
+                        "host_wait_s": round(
+                            getattr(self, "_host_wait_s", 0.0), 3
+                        ),
                         "states_per_sec": round(nv / max(wall, 1e-9), 1),
                         "visited_cap": self.VCAP,
                     }

@@ -34,15 +34,28 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
-from pulsar_tlaplus_tpu.engine.core import build_trace, dedup_core
-from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.engine.core import (
+    build_trace,
+    dedup_core,
+    dedup_core_hash,
+)
+from pulsar_tlaplus_tpu.ops import dedup, hashtable
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.parallel.mesh import AXIS, make_mesh
 from pulsar_tlaplus_tpu.ref import pyeval
 
 
 class ShardedChecker:
-    """BFS checker sharded over a 1-D device mesh."""
+    """BFS checker sharded over a device mesh.
+
+    A 1-D ``("shard",)`` mesh routes candidates straight to their
+    key-owner chip with one ``all_to_all``.  A 2-D ``("dcn", "ici")``
+    mesh (``parallel.mesh.make_mesh2d``) routes hierarchically:
+    owner-slice first over the dcn axis (aggregating all cross-slice
+    traffic into one collective per level round), then owner-chip over
+    ici — so cross-slice bandwidth carries each candidate exactly once.
+    Owner shard = ``key % n_shards`` either way, so counts are
+    identical across mesh shapes (tested 1/2/4/8 flat and 2x4)."""
 
     def __init__(
         self,
@@ -54,10 +67,23 @@ class ShardedChecker:
         visited_cap: int = 1 << 13,
         max_states: int = 1_000_000_000,
         mesh=None,
+        dedup_mode: str = "sort",
+        time_budget_s: Optional[float] = None,
+        metrics_path: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 5,
     ):
+        if dedup_mode not in ("sort", "hash"):
+            raise ValueError(
+                f"dedup_mode must be 'sort' or 'hash', got {dedup_mode!r}"
+            )
+        if dedup_mode == "hash" and visited_cap & (visited_cap - 1):
+            raise ValueError("hash dedup needs a power-of-two visited_cap")
+        self.dedup_mode = dedup_mode
         self.model = model
         self.layout = model.layout
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.axes = tuple(self.mesh.axis_names)
         self.n_shards = self.mesh.devices.size
         if invariants is None:
             invariants = getattr(
@@ -71,7 +97,14 @@ class ShardedChecker:
             # lane); >2^31 states needs a two-word gid encoding (future work)
             raise ValueError("sharded checker supports max_states < 2**31")
         self.max_states = max_states
+        self.time_budget_s = time_budget_s
+        self.metrics_path = metrics_path
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
         self._cap = visited_cap
+        self._ncols = 4 if dedup_mode == "hash" else 3
+        self._viol_i = 4 + self._ncols
+        self._dead_i = self._viol_i + (2 if dedup_mode == "hash" else 1)
         self._jit_cache: Dict[Tuple[str, int], object] = {}
         self._unpack1 = jax.jit(self.layout.unpack)
 
@@ -79,55 +112,82 @@ class ShardedChecker:
     # device code
     # ------------------------------------------------------------------
 
-    def _route(self, packed, valid, parent, action):
-        """Route candidate lanes to their key-owner shard via all_to_all.
-
-        packed u32[L, W] (plus parallel valid/parent/action lanes) ->
-        the lanes this shard owns: u32[n_shards*L, W] etc.
-        """
-        nd = self.n_shards
-        L, W = packed.shape
-        k1, _, _ = dedup.make_keys(packed, self.layout.total_bits)
-        owner = jnp.where(valid, (k1 % nd).astype(jnp.int32), nd)
+    def _bucket(self, dest, valid, arrays, n_dest: int):
+        """Sort lanes by destination, scatter into dense ``[n_dest * L]``
+        send buffers (invalid lanes dropped).  Returns (valid', arrays')."""
+        L = dest.shape[0]
+        d = jnp.where(valid, dest, n_dest)
         iota = jnp.arange(L, dtype=jnp.uint32)
-        sowner, perm_u = jax.lax.sort(
-            (owner.astype(jnp.uint32), iota), num_keys=1, is_stable=True
+        sd, perm_u = jax.lax.sort(
+            (d.astype(jnp.uint32), iota), num_keys=1, is_stable=True
         )
         perm = perm_u.astype(jnp.int32)
-        sp, sv = packed[perm], valid[perm]
-        spar, sact = parent[perm], action[perm]
-        # start offset of each destination bucket in the sorted order
+        sv = valid[perm]
         starts = jnp.searchsorted(
-            sowner, jnp.arange(nd + 1, dtype=jnp.uint32)
+            sd, jnp.arange(n_dest + 1, dtype=jnp.uint32)
         ).astype(jnp.int32)
-        pos_in_bucket = jnp.arange(L, dtype=jnp.int32) - starts[
-            jnp.clip(sowner.astype(jnp.int32), 0, nd)
+        pos = jnp.arange(L, dtype=jnp.int32) - starts[
+            jnp.clip(sd.astype(jnp.int32), 0, n_dest)
         ]
-        # scatter into [nd, L] send buffers; invalid lanes indexed out of
-        # range and dropped
-        flat_idx = jnp.where(
-            sv, sowner.astype(jnp.int32) * L + pos_in_bucket, nd * L
+        flat = jnp.where(sv, sd.astype(jnp.int32) * L + pos, n_dest * L)
+        outs = []
+        for a in arrays:
+            sa = a[perm]
+            z = jnp.zeros((n_dest * L,) + a.shape[1:], a.dtype)
+            outs.append(z.at[flat].set(sa, mode="drop"))
+        sv_out = (
+            jnp.zeros((n_dest * L,), jnp.bool_).at[flat].set(sv, mode="drop")
         )
-        send_packed = jnp.zeros((nd * L, W), jnp.uint32).at[flat_idx].set(
-            sp, mode="drop"
+        return sv_out, outs
+
+    @staticmethod
+    def _a2a(x, axis_name, rows: int):
+        L = x.shape[0] // rows
+        return jax.lax.all_to_all(
+            x.reshape((rows, L) + x.shape[1:]), axis_name, 0, 0
+        ).reshape((rows * L,) + x.shape[1:])
+
+    def _route(self, packed, valid, parent, action):
+        """Route candidate lanes to their key-owner shard.
+
+        1-D mesh: one ``all_to_all`` over the shard axis.  2-D mesh:
+        hierarchical — owner-slice over the dcn axis first (cross-slice
+        bandwidth carries each lane once), then owner-chip over ici.
+        """
+        nd = self.n_shards
+        k1, _, _ = dedup.make_keys(packed, self.layout.total_bits)
+        owner = (k1 % nd).astype(jnp.int32)
+        if len(self.axes) == 1:
+            v, (p, par, act) = self._bucket(
+                owner, valid, (packed, parent, action), nd
+            )
+            ax = self.axes[0]
+            return (
+                self._a2a(p, ax, nd),
+                self._a2a(v, ax, nd),
+                self._a2a(par, ax, nd),
+                self._a2a(act, ax, nd),
+            )
+        dcn_ax, ici_ax = self.axes
+        n_dcn, n_ici = self.mesh.devices.shape
+        # stage 1: to the owner SLICE (carry the owner id along)
+        v, (p, par, act, own) = self._bucket(
+            owner // n_ici, valid, (packed, parent, action, owner), n_dcn
         )
-        send_valid = jnp.zeros((nd * L,), jnp.bool_).at[flat_idx].set(
-            sv, mode="drop"
+        p = self._a2a(p, dcn_ax, n_dcn)
+        v = self._a2a(v, dcn_ax, n_dcn)
+        par = self._a2a(par, dcn_ax, n_dcn)
+        act = self._a2a(act, dcn_ax, n_dcn)
+        own = self._a2a(own, dcn_ax, n_dcn)
+        # stage 2: within the slice, to the owner CHIP
+        v2, (p2, par2, act2) = self._bucket(
+            own % n_ici, v, (p, par, act), n_ici
         )
-        send_parent = jnp.zeros((nd * L,), jnp.int32).at[flat_idx].set(
-            spar, mode="drop"
-        )
-        send_action = jnp.zeros((nd * L,), jnp.int32).at[flat_idx].set(
-            sact, mode="drop"
-        )
-        a2a = lambda x: jax.lax.all_to_all(
-            x.reshape((nd, L) + x.shape[1:]), AXIS, 0, 0
-        ).reshape((nd * L,) + x.shape[1:])
         return (
-            a2a(send_packed),
-            a2a(send_valid),
-            a2a(send_parent),
-            a2a(send_action),
+            self._a2a(p2, ici_ax, n_ici),
+            self._a2a(v2, ici_ax, n_ici),
+            self._a2a(par2, ici_ax, n_ici),
+            self._a2a(act2, ici_ax, n_ici),
         )
 
     def _get_step(self, kind: str):
@@ -138,17 +198,24 @@ class ShardedChecker:
         m = self.model
         nd = self.n_shards
 
-        def insert_body(packed, valid, gids, vk1, vk2, vk3, n_visited):
+        def core(rp, rv, rpar, ract, vk, n_visited):
+            if self.dedup_mode == "hash":
+                return dedup_core_hash(
+                    m, self.invariant_names, rp, rv, rpar, ract, *vk
+                )
+            return dedup_core(
+                m, self.invariant_names, rp, rv, rpar, ract, *vk, n_visited
+            )
+
+        def insert_body(packed, valid, gids, *rest):
+            vk, n_visited = rest[:-1], rest[-1]
             parent = jnp.full(valid.shape, -1, jnp.int32)
             action = jnp.full(valid.shape, -1, jnp.int32)
             rp, rv, rpar, ract = self._route(packed, valid, parent, action)
-            core = dedup_core(
-                m, self.invariant_names, rp, rv, rpar, ract,
-                vk1, vk2, vk3, n_visited,
-            )
-            return core + (jnp.int32(0),)
+            return core(rp, rv, rpar, ract, vk, n_visited) + (jnp.int32(0),)
 
-        def expand_body(frontier, n, gids, vk1, vk2, vk3, n_visited):
+        def expand_body(frontier, n, gids, *rest):
+            vk, n_visited = rest[:-1], rest[-1]
             f = frontier.shape[0]
             row_live = jnp.arange(f, dtype=jnp.int32) < n
             states = jax.vmap(self.layout.unpack)(frontier)
@@ -162,10 +229,7 @@ class ShardedChecker:
             rp, rv, rpar, ract = self._route(
                 packed, valid.reshape(f * m.A), parent_gid, action
             )
-            core = dedup_core(
-                m, self.invariant_names, rp, rv, rpar, ract,
-                vk1, vk2, vk3, n_visited,
-            )
+            out = core(rp, rv, rpar, ract, vk, n_visited)
             if self.check_deadlock:
                 stutter = jax.vmap(m.stutter_enabled)(states)
                 dead = row_live & ~jnp.any(valid, axis=1) & ~stutter
@@ -174,7 +238,7 @@ class ShardedChecker:
                 )
             else:
                 dead_idx = jnp.int32(f)
-            return core + (dead_idx,)
+            return out + (dead_idx,)
 
         body = insert_body if kind == "insert" else expand_body
 
@@ -186,8 +250,9 @@ class ShardedChecker:
             out = body(*args)
             return tuple(o[None] for o in out)
 
-        in_spec = (P(AXIS),)
-        out_spec = P(AXIS)
+        axes_spec = self.axes if len(self.axes) > 1 else self.axes[0]
+        in_spec = (P(axes_spec),)
+        out_spec = P(axes_spec)
         mapped = jax.shard_map(
             shard_fn,
             mesh=self.mesh,
@@ -203,11 +268,37 @@ class ShardedChecker:
     # host driver
     # ------------------------------------------------------------------
 
+    def _empty_vk(self):
+        nd = self.n_shards
+        if self.dedup_mode == "hash":
+            z = jnp.zeros((nd, self._cap + 1), jnp.uint32)
+            return (z, z, z, jnp.zeros((nd, self._cap + 1), jnp.int32))
+        return tuple(
+            jnp.full((nd, self._cap), SENTINEL, jnp.uint32) for _ in range(3)
+        )
+
     def _grow_visited(self, vk, need_per_shard: int):
         cap = self._cap
-        while cap < need_per_shard:
+        target = (
+            2 * need_per_shard if self.dedup_mode == "hash" else need_per_shard
+        )
+        while cap < target:
             cap *= 4
-        if cap != self._cap:
+        if cap == self._cap:
+            return vk
+        if self.dedup_mode == "hash":
+            # rehash each shard's table into the bigger capacity
+            nd = self.n_shards
+            news = [hashtable.empty_table(cap) for _ in range(nd)]
+            for d in range(nd):
+                news[d] = hashtable.rehash_into(
+                    tuple(col[d] for col in vk), news[d]
+                )
+            vk = tuple(
+                jnp.stack([news[d][i] for d in range(nd)])
+                for i in range(4)
+            )
+        else:
             pad = cap - self._cap
             vk = tuple(
                 jnp.concatenate(
@@ -216,16 +307,118 @@ class ShardedChecker:
                 )
                 for col in vk
             )
-            self._cap = cap
+        self._cap = cap
         return vk
 
-    def run(self) -> CheckerResult:
+    def _config_sig(self) -> str:
+        return repr(
+            (
+                getattr(self.model, "c", None),
+                self.invariant_names,
+                self.layout.total_bits,
+                self.dedup_mode,
+                self.n_shards,
+                tuple(self.axes),
+            )
+        )
+
+    def _over_budget(self, n_total: int, t0: float) -> bool:
+        return n_total > self.max_states or (
+            self.time_budget_s is not None
+            and time.time() - t0 > self.time_budget_s
+        )
+
+    def _rewind_metrics(self, resumed_level: int):
+        """Drop metric records for levels the resumed run re-discovers
+        (mirrors engine.bfs.Checker._rewind_metrics)."""
+        import json
+        import os
+
+        if not self.metrics_path or not os.path.exists(self.metrics_path):
+            return
+        kept = []
+        with open(self.metrics_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("level", 0) <= resumed_level:
+                    kept.append(line)
+        kept.append(json.dumps({"resumed_at_level": resumed_level}) + "\n")
+        with open(self.metrics_path, "w") as f:
+            f.writelines(kept)
+
+    def _emit_metrics(self, t0, level, level_count, n_total, frontier_len):
+        if not self.metrics_path:
+            return
+        import json
+
+        wall = time.time() - t0
+        with open(self.metrics_path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "level": level,
+                        "new_states": level_count,
+                        "distinct_states": n_total,
+                        "frontier": frontier_len,
+                        "wall_s": round(wall, 3),
+                        "states_per_sec": round(
+                            n_total / max(wall, 1e-9), 1
+                        ),
+                        "visited_cap_per_shard": self._cap,
+                        "n_shards": self.n_shards,
+                    }
+                )
+                + "\n"
+            )
+
+    def _save_checkpoint(
+        self, vk, n_visited, log, level_sizes, frontier, fgids, t0
+    ):
+        """Level-boundary snapshot (SURVEY.md §2.2-E8, sharded): per-shard
+        visited columns + per-shard frontier + trace log."""
+        import os
+
+        tmp = self.checkpoint_path + ".tmp.npz"
+        total = sum(len(f) for f in frontier)
+        np.savez_compressed(
+            tmp,
+            sig=np.frombuffer(self._config_sig().encode(), dtype=np.uint8),
+            **{f"vk{i}": np.asarray(col) for i, col in enumerate(vk)},
+            n_visited=n_visited,
+            level_sizes=np.asarray(level_sizes, np.int64),
+            fr=(
+                np.concatenate(frontier)
+                if total
+                else np.zeros((0, self.layout.W), np.uint32)
+            ),
+            fr_lens=np.asarray([len(f) for f in frontier], np.int64),
+            fgids=(
+                np.concatenate(fgids) if total else np.zeros((0,), np.int64)
+            ),
+            packed=log.packed_matrix(),
+            parent=log.parents(),
+            action=log.actions(),
+            wall_s=np.float64(time.time() - t0),
+        )
+        os.replace(tmp, self.checkpoint_path)
+
+    def load_checkpoint(self):
+        d = np.load(self.checkpoint_path)
+        sig = d["sig"].tobytes().decode()
+        if sig != self._config_sig():
+            raise ValueError(
+                "checkpoint was written by a different configuration"
+            )
+        return d
+
+    def run(self, resume: bool = False) -> CheckerResult:
         m = self.model
         nd = self.n_shards
         t0 = time.time()
-        vk = tuple(
-            jnp.full((nd, self._cap), SENTINEL, jnp.uint32) for _ in range(3)
-        )
+        vk = self._empty_vk()
         n_visited = np.zeros((nd,), np.int64)
         from pulsar_tlaplus_tpu.engine.statelog import MemoryLog
 
@@ -236,12 +429,21 @@ class ShardedChecker:
         next_parts: List[List[np.ndarray]] = [[] for _ in range(nd)]
         next_gid_parts: List[List[np.ndarray]] = [[] for _ in range(nd)]
 
+        viol_i = self._viol_i
+
         def flush(out) -> Tuple[int, Optional[Tuple[str, int]]]:
             """Harvest all shards' new states into the log and the
             next-level accumulators; returns (n_new_total, violation)."""
             nonlocal n_total
             packed, parent, action, n_new = out[0], out[1], out[2], out[3]
-            viol = np.asarray(out[7])
+            if self.dedup_mode == "hash":
+                n_failed = int(np.asarray(out[viol_i + 1]).sum())
+                if n_failed:
+                    raise RuntimeError(
+                        "sharded hash-table probe overflow — raise "
+                        f"visited_cap ({n_failed} unresolved lanes)"
+                    )
+            viol = np.asarray(out[viol_i])
             n_new = np.asarray(n_new)
             violation = None
             total_new = 0
@@ -286,7 +488,7 @@ class ShardedChecker:
                 next_gid_parts[d] = []
             return fr, gd
 
-        def build_result(violation, deadlock_gid=None):
+        def build_result(violation, deadlock_gid=None, truncated=False):
             wall = time.time() - t0
             res = CheckerResult(
                 distinct_states=n_total,
@@ -295,6 +497,7 @@ class ShardedChecker:
                 wall_s=wall,
                 states_per_sec=n_total / max(wall, 1e-9),
                 level_sizes=level_sizes,
+                truncated=truncated,
             )
             gid = None
             if violation is not None:
@@ -309,34 +512,63 @@ class ShardedChecker:
                 )
             return res
 
-        # ---- level 1: initial states, routed to owners ----
-        n_init = m.n_initial
-        gen = jax.jit(jax.vmap(lambda i: self.layout.pack(m.gen_initial(i))))
-        per_round = nd * self.F
-        dummy_gids = jnp.zeros((nd, self.F), jnp.int32)
-        for start in range(0, n_init, per_round):
-            idx = np.arange(start, start + per_round, dtype=np.int64)
-            packed = np.asarray(gen(jnp.asarray(idx % max(n_init, 1), jnp.int32)))
-            valid = idx < n_init
-            vk = self._grow_visited(
-                vk, int(n_visited.max()) + nd * self.F + 1
+        if resume:
+            d = self.load_checkpoint()
+            if "wall_s" in d:
+                t0 = time.time() - float(d["wall_s"])
+            self._cap = d["vk0"].shape[1] - (
+                1 if self.dedup_mode == "hash" else 0
             )
-            out = self._get_step("insert")(
-                (
-                    jnp.asarray(packed.reshape(nd, self.F, self.layout.W)),
-                    jnp.asarray(valid.reshape(nd, self.F)),
-                    dummy_gids,
-                    *vk,
-                    jnp.asarray(n_visited, jnp.int32),
+            self._jit_cache.clear()
+            vk = tuple(
+                jnp.asarray(d[f"vk{i}"]) for i in range(self._ncols)
+            )
+            n_visited = d["n_visited"].astype(np.int64)
+            if len(d["packed"]):
+                log.append(d["packed"], d["parent"], d["action"])
+            n_total = len(log)
+            level_sizes = [int(x) for x in d["level_sizes"]]
+            lens = d["fr_lens"]
+            offs = np.concatenate([[0], np.cumsum(lens)])
+            fr_all, fg_all = d["fr"], d["fgids"]  # decompress once
+            frontier = [fr_all[offs[i]: offs[i + 1]] for i in range(nd)]
+            fgids = [fg_all[offs[i]: offs[i + 1]] for i in range(nd)]
+            self._rewind_metrics(len(level_sizes))
+        else:
+            # ---- level 1: initial states, routed to owners ----
+            n_init = m.n_initial
+            gen = jax.jit(
+                jax.vmap(lambda i: self.layout.pack(m.gen_initial(i)))
+            )
+            per_round = nd * self.F
+            dummy_gids = jnp.zeros((nd, self.F), jnp.int32)
+            for start in range(0, n_init, per_round):
+                idx = np.arange(start, start + per_round, dtype=np.int64)
+                packed = np.asarray(
+                    gen(jnp.asarray(idx % max(n_init, 1), jnp.int32))
                 )
-            )
-            vk = out[4:7]
-            _nn, violation = flush(out)
-            if violation is not None:
-                level_sizes.append(n_total)
-                return build_result(violation)
-        level_sizes.append(n_total)
-        frontier, fgids = take_next()
+                valid = idx < n_init
+                vk = self._grow_visited(
+                    vk, int(n_visited.max()) + nd * self.F + 1
+                )
+                out = self._get_step("insert")(
+                    (
+                        jnp.asarray(
+                            packed.reshape(nd, self.F, self.layout.W)
+                        ),
+                        jnp.asarray(valid.reshape(nd, self.F)),
+                        dummy_gids,
+                        *vk,
+                        jnp.asarray(n_visited, jnp.int32),
+                    )
+                )
+                vk = out[4:4 + self._ncols]
+                _nn, violation = flush(out)
+                if violation is not None:
+                    level_sizes.append(n_total)
+                    return build_result(violation)
+            level_sizes.append(n_total)
+            frontier, fgids = take_next()
 
         # ---- BFS levels ----
         while any(len(f) for f in frontier):
@@ -365,8 +597,8 @@ class ShardedChecker:
                         jnp.asarray(n_visited, jnp.int32),
                     )
                 )
-                vk = out[4:7]
-                dead = np.asarray(out[8])
+                vk = out[4:4 + self._ncols]
+                dead = np.asarray(out[self._dead_i])
                 _nn, violation = flush(out)
                 if violation is not None:
                     level_sizes.append(n_total - level_base)
@@ -378,13 +610,28 @@ class ShardedChecker:
                             None,
                             deadlock_gid=int(gid_chunk[d][int(dead[d])]),
                         )
-                if n_total > self.max_states:
-                    raise RuntimeError(
-                        f"state explosion: >{self.max_states} states"
-                    )
+                over = self._over_budget(n_total, t0)
+                if over and self.checkpoint_path is None:
+                    # no checkpoint configured: stop immediately
+                    level_sizes.append(n_total - level_base)
+                    return build_result(None, truncated=True)
             if n_total == level_base:
                 break
             level_sizes.append(n_total - level_base)
+            self._emit_metrics(
+                t0, len(level_sizes), n_total - level_base, n_total,
+                sum(len(f) for f in frontier),
+            )
             frontier, fgids = take_next()
+            over = self._over_budget(n_total, t0)
+            if self.checkpoint_path and (
+                over or len(level_sizes) % self.checkpoint_every == 0
+            ):
+                # level boundaries are the consistent snapshot points
+                self._save_checkpoint(
+                    vk, n_visited, log, level_sizes, frontier, fgids, t0
+                )
+            if over:
+                return build_result(None, truncated=True)
 
         return build_result(None)

@@ -16,6 +16,8 @@ from jax.sharding import Mesh
 
 
 AXIS = "shard"
+DCN_AXIS = "dcn"  # across slices (data-center network)
+ICI_AXIS = "ici"  # within a slice (inter-chip interconnect)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -30,3 +32,24 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     import numpy as np
 
     return Mesh(np.array(devs), (AXIS,))
+
+
+def make_mesh2d(
+    n_slices: int, per_slice: int, devices=None
+) -> Mesh:
+    """Multi-slice mesh (SURVEY.md §2.2-E11): a (dcn, ici) grid.  The
+    sharded checker routes fingerprints hierarchically over it —
+    owner-slice first (one all_to_all on the dcn axis, aggregating all
+    cross-slice traffic per slice pair), then owner-chip within the
+    slice (all_to_all on ici)."""
+    import numpy as np
+
+    devs = list(devices if devices is not None else jax.devices())
+    need = n_slices * per_slice
+    if len(devs) < need:
+        raise ValueError(
+            f"need {need} devices, have {len(devs)} "
+            "(for CPU testing set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+        )
+    grid = np.array(devs[:need]).reshape(n_slices, per_slice)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
