@@ -307,6 +307,10 @@ def main(argv=None):
         sys.exit(f"tpu-tlc: config file not found: {cfg_path}")
     tlc_cfg = cfgmod.load(cfg_path)
     invariants = tuple(args.invariant or tlc_cfg.invariants)
+    if not args.sharded and (
+        args.slices > 1 or args.sharded_dedup != "sort"
+    ):
+        sys.exit("tpu-tlc: -slices/-sharded-dedup require -sharded N")
 
     from pulsar_tlaplus_tpu.models import registry
 
@@ -419,7 +423,7 @@ def main(argv=None):
         )
     try:
         r = ck.run(resume=args.recover)
-    except ValueError as e:
+    except (ValueError, RuntimeError) as e:
         sys.exit(f"tpu-tlc: {e}")
     rc = _report(r, constants, time.time() - t0)
     # cfg PROPERTIES are honored automatically after a clean safety pass

@@ -41,7 +41,7 @@ from pulsar_tlaplus_tpu.engine.core import (
 )
 from pulsar_tlaplus_tpu.ops import dedup, hashtable
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
-from pulsar_tlaplus_tpu.parallel.mesh import AXIS, make_mesh
+from pulsar_tlaplus_tpu.parallel.mesh import make_mesh
 from pulsar_tlaplus_tpu.ref import pyeval
 
 

@@ -722,6 +722,13 @@ class Compiler:
     def _c_Index(self, node: A.Index, cenv: CEnv):
         if len(node.args) != 1:
             raise CodegenError("multi-arg application unsupported")
+        if self.abstract:
+            fk = getattr(self, "_fact_key", None)
+            fk = fk(node, cenv) if fk is not None else None
+            if fk is not None:
+                ent = cenv.get(fk)
+                if ent is not None and ent[0] == "cv":
+                    return ent[1]
         f = self.as_cval(self.compile(node.fn, cenv))
         i = self.compile(node.args[0], cenv)
         d = f.desc
@@ -1550,15 +1557,25 @@ class ActionCompiler(Compiler):
             lhs, rhs = rhs, lhs
             op = self._FLIP[op]
         if self.is_dynamic(rhs, cenv):
-            return cenv
-        try:
-            bound = self.host_eval(rhs, cenv)
-        except EvalError:
-            return cenv
-        if not isinstance(bound, int) or isinstance(bound, bool):
-            return cenv
-        hi = {"<": bound - 1, "<=": bound, "=": bound}.get(op)
-        lo = {">": bound + 1, ">=": bound, "=": bound}.get(op)
+            # dynamic bound (e.g. ``lac < added``): use the rhs
+            # DESCRIPTOR's static envelope — lac < added <= added.hi
+            try:
+                rcv = self.as_cval(self.compile(rhs, cenv))
+            except CodegenError:
+                return cenv
+            if not isinstance(rcv.desc, DInt):
+                return cenv
+            blo, bhi = rcv.desc.lo, rcv.desc.hi
+        else:
+            try:
+                bound = self.host_eval(rhs, cenv)
+            except EvalError:
+                return cenv
+            if not isinstance(bound, int) or isinstance(bound, bool):
+                return cenv
+            blo = bhi = bound
+        hi = {"<": bhi - 1, "<=": bhi, "=": bhi}.get(op)
+        lo = {">": blo + 1, ">=": blo, "=": blo}.get(op)
         # Len(v) bound -> narrow the seq cap
         if (
             isinstance(lhs, A.Apply)
@@ -1588,7 +1605,41 @@ class ActionCompiler(Compiler):
                 return cenv.child(
                     {lhs.name: ("cv", CVal(DInt(nlo, nhi), None))}
                 )
+        # guard on an indexed element, e.g. ``published[c] < Limit``:
+        # record an index-level fact (sound: scoped to this lane's env
+        # and to this exact host index) consulted by _c_Index
+        fk = self._fact_key(lhs, cenv)
+        if fk is not None:
+            cur = self.as_cval(self.compile(lhs, cenv))
+            if isinstance(cur.desc, DInt):
+                d = cur.desc
+                nlo = max(d.lo, lo) if lo is not None else d.lo
+                nhi = min(d.hi, hi) if hi is not None else d.hi
+                if nlo <= nhi:
+                    return cenv.child(
+                        {fk: ("cv", CVal(DInt(nlo, nhi), None))}
+                    )
         return cenv
+
+    def _fact_key(self, node, cenv: CEnv) -> Optional[str]:
+        """Stable key for ``Name[host-index]`` / ``Name[i][j]`` chains."""
+        idxs = []
+        while isinstance(node, A.Index) and len(node.args) == 1:
+            if self.is_dynamic(node.args[0], cenv):
+                return None
+            try:
+                idxs.append(self.host_eval(node.args[0], cenv))
+            except EvalError:
+                return None
+            node = node.fn
+        if not idxs or not isinstance(node, A.Name):
+            return None
+        ent = cenv.get(node.name)
+        if ent is None or ent[0] != "cv":
+            return None
+        # key by the resolved binding's identity, not the bare name, so a
+        # LET binding shadowing a state variable never inherits its facts
+        return f"__fact__:{id(ent)}:{list(reversed(idxs))!r}"
 
     def _narrow_membership(self, lhs, rhs, cenv: CEnv) -> CEnv:
         """Guard ``v \\in S`` or ``v ± c \\in S``: bound v's int range by

@@ -125,6 +125,9 @@ def lookup_insert(
     return is_new, t1, t2, t3, occ, jnp.sum(pending.astype(jnp.int32))
 
 
+_REHASH_STEP = jax.jit(lookup_insert)
+
+
 def rehash_into(
     old: Tuple[jax.Array, ...],
     new: Tuple[jax.Array, ...],
@@ -139,7 +142,7 @@ def rehash_into(
     t1, t2, t3, occ = old
     n1, n2, n3, nocc = new
     cap = t1.shape[0] - 1
-    step = jax.jit(lookup_insert, static_argnames=())
+    step = _REHASH_STEP
     for start in range(0, cap, chunk):
         sl = slice(start, min(start + chunk, cap))
         is_new, n1, n2, n3, nocc, failed = step(
