@@ -51,7 +51,12 @@ from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.ref import pyeval
 
 BIG = jnp.int32(2**31 - 1)
-IDX_BITS = 25  # payload: low 25 bits candidate index, high 7 bits verdicts
+# payload word: low 25 bits candidate index, bits 25..30 invariant
+# verdicts, bit 31 the candidate tag (visited entries carry payload 0,
+# so the payload doubles as the visited-vs-candidate sort tie-breaker —
+# one fewer 42M-element operand in the dedup sort)
+IDX_BITS = 25
+TAG_BIT = jnp.uint32(1 << 31)
 
 
 class DeviceChecker:
@@ -85,7 +90,7 @@ class DeviceChecker:
                 model, "default_invariants", pyeval.DEFAULT_INVARIANTS
             )
         self.invariant_names = tuple(invariants)
-        if len(self.invariant_names) > 32 - IDX_BITS:
+        if len(self.invariant_names) > 31 - IDX_BITS:
             raise ValueError("too many invariants for the payload word")
         self.check_deadlock = check_deadlock
         self.A = model.A
@@ -185,7 +190,7 @@ class DeviceChecker:
             idx = (i * fa + jnp.arange(fa, dtype=jnp.uint32)).astype(
                 jnp.uint32
             )
-            payload = idx | (vbits.reshape(fa) << IDX_BITS)
+            payload = idx | (vbits.reshape(fa) << IDX_BITS) | TAG_BIT
             if self.check_deadlock:
                 stut = jax.vmap(m.stutter_enabled)(states)
                 dead_rows = live & ~jnp.any(valid, axis=1) & ~stut
@@ -244,7 +249,11 @@ class DeviceChecker:
             for b, fn in enumerate(inv_fns):
                 ok = jax.vmap(fn)(states)
                 vbits = vbits | ((~ok & valid).astype(jnp.uint32) << b)
-            payload = jnp.arange(NC, dtype=jnp.uint32) | (vbits << IDX_BITS)
+            payload = (
+                jnp.arange(NC, dtype=jnp.uint32)
+                | (vbits << IDX_BITS)
+                | TAG_BIT
+            )
             return k1, k2, k3, packed, payload, BIG
 
         fn = jax.jit(step)
@@ -260,21 +269,19 @@ class DeviceChecker:
         VCAP, NC = self.VCAP, self.NC
 
         def step(vk1, vk2, vk3, ck1, ck2, ck3, payload):
-            # tag via iota, not concat of constant halves — XLA folds a
-            # constant concat into a materialized 42M-element literal
-            # (tens of seconds of compile + a huge executable upload)
-            tag = (lax.iota(jnp.uint32, VCAP + NC) >= VCAP).astype(
-                jnp.uint32
-            )
+            # visited entries carry payload 0 and candidates have TAG_BIT
+            # set, so the payload column alone orders visited before
+            # same-key candidates — no separate tag operand in the sort
             pay = jnp.concatenate(
-                [jnp.full((VCAP,), 0xFFFFFFFF, jnp.uint32), payload]
+                [jnp.zeros((VCAP,), jnp.uint32), payload]
             )
             c1 = jnp.concatenate([vk1, ck1])
             c2 = jnp.concatenate([vk2, ck2])
             c3 = jnp.concatenate([vk3, ck3])
-            s1, s2, s3, st, sp = lax.sort(
-                (c1, c2, c3, tag, pay), num_keys=5, is_stable=False
+            s1, s2, s3, sp = lax.sort(
+                (c1, c2, c3, pay), num_keys=4, is_stable=False
             )
+            st = sp >> 31  # 1 = candidate, 0 = visited
             sent = (s1 == SENTINEL) & (s2 == SENTINEL) & (s3 == SENTINEL)
             prev_same = jnp.zeros((VCAP + NC,), jnp.bool_)
             prev_same = prev_same.at[1:].set(
@@ -325,7 +332,7 @@ class DeviceChecker:
             idxs = (new_pay & jnp.uint32((1 << IDX_BITS) - 1)).astype(
                 jnp.int32
             )
-            vbits = new_pay >> IDX_BITS
+            vbits = (new_pay >> IDX_BITS) & jnp.uint32(0x3F)
             rows = packed[jnp.where(live, idxs, 0)]
             if is_init:
                 par = -1 - (parent_base + idxs)
