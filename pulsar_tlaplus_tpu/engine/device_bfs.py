@@ -332,7 +332,9 @@ class DeviceChecker:
             idxs = (new_pay & jnp.uint32((1 << IDX_BITS) - 1)).astype(
                 jnp.int32
             )
-            vbits = (new_pay >> IDX_BITS) & jnp.uint32(0x3F)
+            vbits = (new_pay >> IDX_BITS) & jnp.uint32(
+                (1 << (31 - IDX_BITS)) - 1
+            )
             rows = packed[jnp.where(live, idxs, 0)]
             if is_init:
                 par = -1 - (parent_base + idxs)
