@@ -378,6 +378,176 @@ class DeviceChecker:
         self._jits[key] = fn
         return fn
 
+    SEED_CHUNK = 1 << 15
+    SEED_VCAP = 1 << 16
+
+    def _seed_jits(self):
+        """Small-shape pipeline for host-seeded warm starts: the seed
+        prefix is tiny, so it must not pay the full-size (data-
+        independent) sort/expand latency of the main kernels.  Compiles
+        in seconds (sort lowering scales with width)."""
+        key = ("seedmerge",)
+        if key in self._jits:
+            return self._jits[key]
+        NCs, VCs = self.SEED_CHUNK, self.SEED_VCAP
+        layout = self.layout
+        m = self.model
+        inv_fns = [m.invariants[n] for n in self.invariant_names]
+        n_inv = len(self.invariant_names)
+
+        def merge(vk1, vk2, vk3, rows, n_valid, n_visited, viol, gid_base):
+            k1, k2, k3 = dedup.make_keys(rows, layout.total_bits)
+            lane = jnp.arange(NCs, dtype=jnp.int32)
+            valid = lane < n_valid
+            k1 = jnp.where(valid, k1, SENTINEL)
+            k2 = jnp.where(valid, k2, SENTINEL)
+            k3 = jnp.where(valid, k3, SENTINEL)
+            pay = lane.astype(jnp.uint32) | TAG_BIT
+            c1 = jnp.concatenate([vk1, k1])
+            c2 = jnp.concatenate([vk2, k2])
+            c3 = jnp.concatenate([vk3, k3])
+            cp = jnp.concatenate([jnp.zeros((VCs,), jnp.uint32), pay])
+            s1, s2, s3, sp = lax.sort(
+                (c1, c2, c3, cp), num_keys=4, is_stable=False
+            )
+            sent = (s1 == SENTINEL) & (s2 == SENTINEL) & (s3 == SENTINEL)
+            prev_same = jnp.zeros((VCs + NCs,), jnp.bool_)
+            prev_same = prev_same.at[1:].set(
+                (s1[1:] == s1[:-1])
+                & (s2[1:] == s2[:-1])
+                & (s3[1:] == s3[:-1])
+            )
+            new_flag = ((sp >> 31) == 1) & ~sent & ~prev_same
+            keep = ~sent & (((sp >> 31) == 0) | new_flag)
+            kk = (~keep).astype(jnp.uint32)
+            m1 = jnp.where(keep, s1, SENTINEL)
+            m2 = jnp.where(keep, s2, SENTINEL)
+            m3 = jnp.where(keep, s3, SENTINEL)
+            _, v1, v2, v3 = lax.sort(
+                (kk, m1, m2, m3), num_keys=1, is_stable=True
+            )
+            # fused invariant check on the seed states (discovery-time
+            # semantics, same as the main expand path)
+            states = jax.vmap(layout.unpack)(rows)
+            vnew = []
+            for fn in inv_fns:
+                ok = jax.vmap(fn)(states)
+                bad = valid & ~ok
+                vnew.append(
+                    jnp.min(jnp.where(bad, gid_base + lane, BIG))
+                )
+            if n_inv:
+                viol = jnp.minimum(viol, jnp.stack(vnew))
+            n_new = jnp.sum(new_flag.astype(jnp.int32))
+            return (
+                v1[:VCs], v2[:VCs], v3[:VCs],
+                n_visited + n_new, viol,
+            )
+
+        fn = jax.jit(merge, donate_argnums=(0, 1, 2))
+        self._jits[key] = fn
+        return fn
+
+    def _seed_write_jit(self):
+        key = ("seedwrite", self.FCAP, self.LCAP)
+        if key in self._jits:
+            return self._jits[key]
+
+        def write(nxt, n_next, parent_log, lane_log, off, rows, par, lane,
+                  count):
+            nxt = lax.dynamic_update_slice(nxt, rows, (n_next, 0))
+            parent_log = lax.dynamic_update_slice(parent_log, par, (off,))
+            lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
+            return nxt, n_next + count, parent_log, lane_log
+
+        fn = jax.jit(write, donate_argnums=(0, 2, 3))
+        self._jits[key] = fn
+        return fn
+
+    def _load_seed(self, bufs, st, seed):
+        """Bulk-load a host-enumerated BFS prefix: packed states in BFS
+        (= gid) order with parent gids (roots: ``-1 - init_idx``) and
+        action lanes, plus per-level sizes.  The caller guarantees the
+        states are distinct, level-complete, and deadlock-free (they
+        were fully expanded by the host).  Returns level_sizes."""
+        rows, parents, lanes, lsizes = seed
+        rows = np.ascontiguousarray(rows, np.uint32)
+        parents = np.ascontiguousarray(parents, np.int32)
+        lanes = np.ascontiguousarray(lanes, np.int32)
+        n = len(rows)
+        if sum(lsizes) != n:
+            raise ValueError("seed level sizes do not sum to the state count")
+        if n > self.SEED_VCAP // 2 or n > self.SCAP:
+            raise ValueError(f"seed too large ({n} states)")
+        # seed windows are SEED_CHUNK rows, so every buffer must admit
+        # one full chunk past the seed in addition to the normal bounds
+        self._grow_visited(bufs, max(n + self.NC, self.SEED_VCAP))
+        self._grow_frontier(
+            bufs, max(n + self.NC, self.SEED_CHUNK)
+        )
+        self._grow_logs(
+            bufs, max(n + self.NC, n + self.SEED_CHUNK - self.NC)
+        )
+        if self.LCAP + self.NC < n + self.SEED_CHUNK:
+            raise ValueError(
+                "seed too large for max_states: need max_states >= "
+                f"{n + self.SEED_CHUNK - self.NC} (the padded seed write "
+                "window must never clamp)"
+            )
+        merge = self._seed_jits()
+        write = self._seed_write_jit()
+        NCs = self.SEED_CHUNK
+        W = self.W
+        vks = tuple(
+            jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
+            for _ in range(3)
+        )
+        n_vis = jnp.int32(0)
+        off = 0
+        last = lsizes[-1]
+        for li, count in enumerate(lsizes):
+            if li == len(lsizes) - 1:
+                st["n_next"] = jnp.int32(0)  # frontier = last seed level
+            for c0 in range(0, count, NCs):
+                cn = min(NCs, count - c0)
+                chunk = np.zeros((NCs, W), np.uint32)
+                chunk[:cn] = rows[off + c0: off + c0 + cn]
+                par = np.zeros((NCs,), np.int32)
+                par[:cn] = parents[off + c0: off + c0 + cn]
+                lan = np.zeros((NCs,), np.int32)
+                lan[:cn] = lanes[off + c0: off + c0 + cn]
+                jrows = jnp.asarray(chunk)
+                vk1, vk2, vk3, n_vis, st["viol"] = merge(
+                    *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
+                    jnp.int32(off + c0),
+                )
+                vks = (vk1, vk2, vk3)
+                (
+                    bufs["next"], st["n_next"], bufs["parent"],
+                    bufs["lane"],
+                ) = write(
+                    bufs["next"], st["n_next"], bufs["parent"],
+                    bufs["lane"], jnp.int32(off + c0), jrows,
+                    jnp.asarray(par), jnp.asarray(lan), jnp.int32(cn),
+                )
+            off += count
+        if int(np.asarray(n_vis)) != n:
+            raise ValueError(
+                "seed states are not all distinct "
+                f"({int(np.asarray(n_vis))} of {n} unique)"
+            )
+        # hand the small sorted columns to the main engine (SENTINEL pad)
+        bufs["vk"] = tuple(
+            jnp.concatenate(
+                [col, jnp.full((self.VCAP - self.SEED_VCAP,), SENTINEL,
+                               jnp.uint32)]
+            )
+            for col in vks
+        )
+        st["n_visited"] = jnp.int32(n)
+        st["n_next"] = jnp.int32(last)
+        return [int(x) for x in lsizes]
+
     def _stats_jit(self):
         key = ("stats",)
         if key in self._jits:
@@ -454,9 +624,10 @@ class DeviceChecker:
 
     # --------------------------------------------------------------- run
 
-    def warmup(self) -> float:
+    def warmup(self, seed: bool = False) -> float:
         """Compile every hot-path jit at the current tiers on dummy data
-        (outside any timed budget); returns the compile wall time."""
+        (outside any timed budget); returns the compile wall time.
+        ``seed=True`` also compiles the small-shape seed pipeline."""
         t0 = time.time()
         z = jnp.zeros
         n_inv = len(self.invariant_names)
@@ -518,9 +689,42 @@ class DeviceChecker:
                 z((self.LCAP + self.NC,), jnp.int32), jnp.int32(-1),
             )
         )
+        if seed:
+            merge = self._seed_jits()
+            write = self._seed_write_jit()
+            vks = tuple(
+                jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
+                for _ in range(3)
+            )
+            drain(
+                merge(
+                    *vks, z((self.SEED_CHUNK, self.W), jnp.uint32),
+                    jnp.int32(0), jnp.int32(0),
+                    jnp.full((n_inv,), int(BIG), jnp.int32), jnp.int32(0),
+                )
+            )
+            drain(
+                write(
+                    z((self.FCAP, self.W), jnp.uint32), jnp.int32(0),
+                    z((self.LCAP + self.NC,), jnp.int32),
+                    z((self.LCAP + self.NC,), jnp.int32), jnp.int32(0),
+                    z((self.SEED_CHUNK, self.W), jnp.uint32),
+                    z((self.SEED_CHUNK,), jnp.int32),
+                    z((self.SEED_CHUNK,), jnp.int32), jnp.int32(0),
+                )
+            )
+            warm_pack = getattr(self.model, "warm_host_seed", None)
+            if warm_pack is not None:
+                warm_pack()
         return time.time() - t0
 
-    def run(self) -> CheckerResult:
+    def run(self, seed=None) -> CheckerResult:
+        """``seed``: optional host-enumerated BFS prefix
+        ``(packed_rows, parent_gids, action_lanes, level_sizes)`` —
+        see :meth:`_load_seed`.  The engine bulk-loads it through the
+        small-shape pipeline and starts expanding at the last seed
+        level, skipping the full-size kernel latency that tiny early
+        levels would otherwise pay."""
         t0 = time.time()
         m = self.model
         n_inv = len(self.invariant_names)
@@ -577,17 +781,36 @@ class DeviceChecker:
             st["n_visited"] = n_vis2
             st["viol"] = viol2
 
-        # ---- level 1: initial states (compaction.tla:188-202) ----
-        n_init = m.n_initial
-        if n_init > self.SCAP:
-            raise ValueError("initial-state set exceeds max_states")
-        self._grow_visited(bufs, n_init + self.NC)
-        self._grow_frontier(bufs, n_init + self.NC)
-        self._grow_logs(bufs, n_init + self.NC)
-        for f_off in range(0, n_init, self.NC):
-            dispatch(self._init_jit(), (jnp.int32(f_off),), f_off, True)
-        stats = fetch()
-        level_sizes = [int(stats[0])]
+        if seed is not None:
+            level_sizes = self._load_seed(bufs, st, seed)
+            stats = fetch()
+            fv = self._first_viol(stats)
+            gid = fv[1] if fv is not None else (
+                int(stats[2]) if int(stats[2]) < int(BIG) else None
+            )
+            if gid is not None:
+                # violation inside the seeded prefix: the diameter is the
+                # violating state's level, not the full seed depth
+                cum = 0
+                for li, cnt in enumerate(level_sizes):
+                    cum += cnt
+                    if gid < cum:
+                        level_sizes = level_sizes[: li + 1]
+                        break
+        else:
+            # ---- level 1: initial states (compaction.tla:188-202) ----
+            n_init = m.n_initial
+            if n_init > self.SCAP:
+                raise ValueError("initial-state set exceeds max_states")
+            self._grow_visited(bufs, n_init + self.NC)
+            self._grow_frontier(bufs, n_init + self.NC)
+            self._grow_logs(bufs, n_init + self.NC)
+            for f_off in range(0, n_init, self.NC):
+                dispatch(
+                    self._init_jit(), (jnp.int32(f_off),), f_off, True
+                )
+            stats = fetch()
+            level_sizes = [int(stats[0])]
 
         # ---- BFS levels ----
         while True:

@@ -476,6 +476,143 @@ class CompactionModel:
             actions.append(pyeval.ACTION_NAMES[int(self.action_ids[lane])])
         return states, actions
 
+    def host_seed(
+        self, max_level_states: int = 30_000, max_total: int = 32_000
+    ):
+        """Host-enumerated BFS prefix for ``DeviceChecker.run(seed=...)``.
+
+        The device engine's full-size kernels have data-independent
+        latency (sorts), so tiny early levels cost as much as huge ones;
+        the Python oracle enumerates them at >100k states/s instead.
+        Returns ``(packed_rows, parent_gids, action_lanes, level_sizes)``
+        covering every BFS level that fits the caps — level-complete, so
+        the engine can take over at the last included level's frontier.
+        """
+        c = self.c
+        states: list = []
+        gid_of: dict = {}
+        parents: list = []
+        lanes: list = []
+        lsizes: list = []
+        for s in pyeval.initial_states(c):
+            if s in gid_of:
+                continue
+            gid_of[s] = len(states)
+            states.append(s)
+            # root marker encodes gen_initial's mixed-radix index (NOT
+            # the enumeration position: pyeval enumerates position 0 as
+            # the most-significant digit, gen_initial as the least)
+            parents.append(-1 - self._init_index_of(s))
+            lanes.append(0)
+            if len(states) > max_total:
+                raise ValueError("initial-state set exceeds the seed caps")
+        lsizes.append(len(states))
+        frontier = list(states)
+        while True:
+            new = []
+            for s in frontier:
+                sg = gid_of[s]
+                any_succ = False
+                for aid, t in pyeval.successors(c, s):
+                    any_succ = True
+                    if t in gid_of:
+                        continue
+                    gid_of[t] = len(states)
+                    states.append(t)
+                    parents.append(sg)
+                    lanes.append(self._lane_of(aid, t))
+                    new.append(t)
+                if not any_succ:
+                    raise ValueError(
+                        "deadlock state inside the seed prefix — check "
+                        "without a seed"
+                    )
+            if not new:
+                break
+            if len(new) > max_level_states or len(states) > max_total:
+                # the level that overflowed is dropped: seeds must be
+                # level-complete (partial levels would corrupt BFS depth)
+                for t in new:
+                    del gid_of[t]
+                del states[-len(new):]
+                del parents[-len(new):]
+                del lanes[-len(new):]
+                break
+            lsizes.append(len(new))
+            frontier = new
+        rows = self._pack_pystates(states)
+        return (
+            rows,
+            np.asarray(parents, np.int32),
+            np.asarray(lanes, np.int32),
+            lsizes,
+        )
+
+    SEED_PACK_CHUNK = 1 << 12
+
+    def _seed_pack_fn(self):
+        if not hasattr(self, "_seed_pack_cache"):
+            self._seed_pack_cache = jax.jit(jax.vmap(self.layout.pack))
+        return self._seed_pack_cache
+
+    def warm_host_seed(self) -> None:
+        """Precompile the fixed-chunk seed packer (engine warmup hook)."""
+        z = SState(
+            *[
+                jnp.zeros(
+                    (self.SEED_PACK_CHUNK,) + np.shape(getattr(
+                        self.gen_initial(jnp.int32(0)), f
+                    )),
+                    jnp.uint32
+                    if f == "led_mask"
+                    else jnp.int32,
+                )
+                for f in SState._fields
+            ]
+        )
+        np.asarray(self._seed_pack_fn()(z)[0, 0])
+
+    def _pack_pystates(self, states) -> np.ndarray:
+        """pyeval.States -> packed rows, via fixed-size chunks so the
+        packer compiles once (and can be warmed up-front).  Stacks on
+        the HOST — a per-state tree-map would create hundreds of
+        thousands of tiny transfers on the tunnel backend."""
+        ss = [self.from_pystate(s) for s in states]
+        n = len(ss)
+        C = self.SEED_PACK_CHUNK
+        out = np.zeros((n, self.layout.W), np.uint32)
+        pack = self._seed_pack_fn()
+        for c0 in range(0, n, C):
+            cn = min(C, n - c0)
+            cols = []
+            for f in SState._fields:
+                col = np.stack(
+                    [getattr(s, f) for s in ss[c0: c0 + cn]]
+                )
+                if cn < C:
+                    pad = np.zeros((C - cn,) + col.shape[1:], col.dtype)
+                    col = np.concatenate([col, pad])
+                cols.append(jnp.asarray(col))
+            out[c0: c0 + cn] = np.asarray(pack(SState(*cols)))[:cn]
+        return out
+
+    def _init_index_of(self, s: pyeval.State) -> int:
+        """gen_initial index of an initial state (position i is the
+        i-th least-significant base-|KeySet|*|ValueSet| digit)."""
+        if self.c.model_producer:
+            return 0
+        idx = 0
+        for i, (_mid, k, v) in enumerate(s.messages):
+            idx += (k * (self.c.num_values + 1) + v) * (self.kv ** i)
+        return idx
+
+    def _lane_of(self, aid: int, child: pyeval.State) -> int:
+        """Action id (+ the produced child) -> successor lane index."""
+        if aid == 0:  # Producer: lane encodes the appended (key, value)
+            _mid, key, val = child.messages[-1]
+            return key * (self.c.num_values + 1) + val
+        return self.n_producer_lanes + (aid - 1)
+
     def _apply_lane_py(self, ps: pyeval.State, lane: int) -> pyeval.State:
         c = self.c
         if lane < self.n_producer_lanes:

@@ -76,6 +76,58 @@ def test_device_engine_duplicate_null_key_counterexample():
     )
 
 
+def test_device_engine_host_seeded_matches_oracle():
+    """A host-enumerated BFS prefix (warm start) must not change counts,
+    diameter, or verdicts; the handoff level structure must line up."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    m = CompactionModel(c)
+    seed = m.host_seed(max_level_states=40, max_total=120)
+    assert len(seed[3]) > 1  # actually seeds multiple levels
+    got = DeviceChecker(
+        m, invariants=(), sub_batch=64, visited_cap=1 << 10,
+        frontier_cap=1 << 10,
+    ).run(seed=seed)
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.violation is None and not got.deadlock
+
+
+def test_device_engine_host_seeded_violation_trace():
+    """A violation discovered after the seeded prefix must replay a
+    valid counterexample THROUGH the prefix (seed parents/lanes exact)."""
+    m = CompactionModel(pe.SHIPPED_CFG)
+    seed = m.host_seed(max_level_states=3000, max_total=5000)
+    assert len(seed[3]) > 2
+    r = DeviceChecker(
+        m, invariants=("CompactedLedgerLeak",), sub_batch=2048,
+        visited_cap=1 << 16, frontier_cap=1 << 15,
+    ).run(seed=seed)
+    assert r.violation == "CompactedLedgerLeak"
+    assert r.diameter == 12
+    assert len(r.trace) == 12
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r.trace, r.trace_actions, "CompactedLedgerLeak"
+    )
+
+
+def test_device_engine_host_seeded_violation_inside_seed():
+    """An invariant violated by a state inside the seed prefix is still
+    reported (the seed pipeline fuses the same invariant checks), and
+    the diameter is the violation's level even when the seed runs much
+    deeper than the violating state."""
+    m = CompactionModel(pe.SHIPPED_CFG)
+    seed = m.host_seed(max_level_states=12000, max_total=20000)
+    assert len(seed[3]) > 4  # seed strictly deeper than the depth-4 bug
+    r = DeviceChecker(
+        m, invariants=("DuplicateNullKeyMessage",), sub_batch=2048,
+        visited_cap=1 << 16, frontier_cap=1 << 15,
+    ).run(seed=seed)
+    assert r.violation == "DuplicateNullKeyMessage"
+    assert r.diameter == 4  # depth-4 bug: inside the seeded prefix
+    assert len(r.trace) == 4
+
+
 def test_device_engine_max_states_truncation():
     m = CompactionModel(SMALL_CONFIGS["producer_on"])
     r = DeviceChecker(
