@@ -32,21 +32,28 @@ def _report(r, constants, wall: float) -> int:
     3 truncated — a truncated search is NOT a verification result)."""
     from pulsar_tlaplus_tpu.utils.render import render_trace
 
+    def _print_trace():
+        if r.trace is None:
+            # e.g. HBM exhaustion poisoned the trace logs: the verdict
+            # stands but no counterexample can be reconstructed
+            print("(trace unavailable: run was truncated before the "
+                  "counterexample could be reconstructed)")
+        else:
+            print("The behavior up to this point is:")
+            print(render_trace(r.trace, r.trace_actions, constants))
+
     if r.violation == "__EvalError__":
         print(
             "Error: evaluating the spec on this state is undefined "
             "(TLC would report an evaluation error here)."
         )
-        print("The behavior up to this point is:")
-        print(render_trace(r.trace, r.trace_actions, constants))
+        _print_trace()
     elif r.violation and r.violation != "Deadlock":
         print(f"Error: Invariant {r.violation} is violated.")
-        print("The behavior up to this point is:")
-        print(render_trace(r.trace, r.trace_actions, constants))
+        _print_trace()
     elif r.deadlock:
         print("Error: Deadlock reached.")
-        print("The behavior up to this point is:")
-        print(render_trace(r.trace, r.trace_actions, constants))
+        _print_trace()
     print(
         f"{r.distinct_states} distinct states found, "
         f"search depth (diameter) {r.diameter}."

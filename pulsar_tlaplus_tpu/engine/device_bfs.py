@@ -480,10 +480,14 @@ class DeviceChecker:
         if n > self.SEED_VCAP // 2 or n > self.SCAP:
             raise ValueError(f"seed too large ({n} states)")
         # seed windows are SEED_CHUNK rows, so every buffer must admit
-        # one full chunk past the seed in addition to the normal bounds
+        # one full chunk past the worst-case write offset: frontier
+        # writes start at n_next (up to the last level's size, < n) and
+        # span SEED_CHUNK padded rows — if FCAP were smaller the
+        # dynamic_update_slice would clamp and silently overwrite
+        # earlier frontier rows (same guard the logs get below)
         self._grow_visited(bufs, max(n + self.NC, self.SEED_VCAP))
         self._grow_frontier(
-            bufs, max(n + self.NC, self.SEED_CHUNK)
+            bufs, max(n + self.SEED_CHUNK, n + self.NC)
         )
         self._grow_logs(
             bufs, max(n + self.NC, n + self.SEED_CHUNK - self.NC)
@@ -749,6 +753,7 @@ class DeviceChecker:
         stats_fn = self._stats_jit()
 
         self._host_wait_s = 0.0
+        self._bufs_poisoned = False
 
         def fetch():
             tf = time.time()
@@ -877,12 +882,14 @@ class DeviceChecker:
                 # Only the small stats scalars are read from here on; the
                 # big buffers may hold donated/poisoned storage.
                 self._log(f"HBM exhausted mid-level: truncating ({e!r:.120})")
+                self._bufs_poisoned = True
                 stop = True
             try:
                 stats = fetch()
             except Exception as e:  # noqa: BLE001
                 if "RESOURCE_EXHAUSTED" not in str(e):
                     raise
+                self._bufs_poisoned = True
                 stop = True  # keep the last successfully fetched stats
             nv = int(stats[0])
             level_count = max(nv - (level_base + n_frontier), 0)
@@ -1003,7 +1010,15 @@ class DeviceChecker:
             res.violation = "Deadlock"
             gid = dead_gid
         if gid is not None:
-            res.trace, res.trace_actions = self._trace(
-                bufs, gid, len(level_sizes) + 2
-            )
+            if getattr(self, "_bufs_poisoned", False):
+                # after RESOURCE_EXHAUSTED the parent/lane logs may hold
+                # donated/poisoned storage — walking them could crash or
+                # fabricate a trace; report the verdict without one
+                res.trace = None
+                res.trace_actions = None
+                res.truncated = True
+            else:
+                res.trace, res.trace_actions = self._trace(
+                    bufs, gid, len(level_sizes) + 2
+                )
         return res

@@ -418,6 +418,12 @@ class ShardedChecker:
         m = self.model
         nd = self.n_shards
         t0 = time.time()
+        # ``t0`` is rewound on resume so wall_s/states_per_sec stay
+        # cumulative across the whole logical run; the time budget gets
+        # its own fresh clock (``budget_t0``) so a resumed run always
+        # has ``time_budget_s`` of fresh runway instead of being
+        # immediately over budget and crawling one level per resume
+        budget_t0 = t0
         vk = self._empty_vk()
         n_visited = np.zeros((nd,), np.int64)
         from pulsar_tlaplus_tpu.engine.statelog import MemoryLog
@@ -610,7 +616,7 @@ class ShardedChecker:
                             None,
                             deadlock_gid=int(gid_chunk[d][int(dead[d])]),
                         )
-                over = self._over_budget(n_total, t0)
+                over = self._over_budget(n_total, budget_t0)
                 if over and self.checkpoint_path is None:
                     # no checkpoint configured: stop immediately
                     level_sizes.append(n_total - level_base)
@@ -623,7 +629,7 @@ class ShardedChecker:
                 sum(len(f) for f in frontier),
             )
             frontier, fgids = take_next()
-            over = self._over_budget(n_total, t0)
+            over = self._over_budget(n_total, budget_t0)
             if self.checkpoint_path and (
                 over or len(level_sizes) % self.checkpoint_every == 0
             ):

@@ -2018,27 +2018,54 @@ class CompiledSpec:
         out = {}
         for name in self.requested_invariants:
             out[name] = self._invariant_fn(name)
-        out["__EvalError__"] = lambda s: ~jnp.asarray(s[ERR_VAR])
+        out["__EvalError__"] = self._eval_error_fn()
         return out
+
+    def _compile_invariant(self, name: str, state):
+        if name not in self.spec.defs:
+            raise CodegenError(f"spec defines no invariant {name}")
+        body = self.spec.defs[name].body
+        c = Compiler(self.spec)
+        cenv = CEnv(
+            {
+                v: ("cv", CVal(self.var_descs[v], state[v]))
+                for v in self.spec.vars
+            }
+        )
+        return c.cbool(body, cenv)
 
     def _invariant_fn(self, name: str):
         if name not in self.spec.defs:
             raise CodegenError(f"spec defines no invariant {name}")
-        body = self.spec.defs[name].body
 
         def fn(state):
-            c = Compiler(self.spec)
-            cenv = CEnv(
-                {
-                    v: ("cv", CVal(self.var_descs[v], state[v]))
-                    for v in self.spec.vars
-                }
-            )
-            cv = c.cbool(body, cenv)
+            cv = self._compile_invariant(name, state)
             ok = cv.data
             if cv.poison is not FALSE:
-                ok = ok & ~cv.poison
+                # poison while evaluating the invariant is an evaluation
+                # error, not a violation of ``name`` — mask it to "ok"
+                # here; ``__EvalError__`` (which re-derives the same
+                # poison, CSE'd by XLA inside the fused check) reports
+                # it with TLC's evaluation-error message instead
+                ok = ok | jnp.asarray(cv.poison)
             return ok
+
+        return fn
+
+    def _eval_error_fn(self):
+        """Auto-invariant: no lane reached this state through poisoned
+        Init/Next evaluation (the ``ERR_VAR`` bit), and no requested
+        invariant's own evaluation poisons on it (TLC raises an
+        evaluation error in both cases rather than reporting the
+        invariant as violated)."""
+
+        def fn(state):
+            bad = jnp.asarray(state[ERR_VAR])
+            for name in self.requested_invariants:
+                cv = self._compile_invariant(name, state)
+                if cv.poison is not FALSE:
+                    bad = bad | jnp.asarray(cv.poison)
+            return ~bad
 
         return fn
 

@@ -128,6 +128,33 @@ def test_compiled_lane_order_matches_interpreter(module):
         frontier = nxt
 
 
+def test_invariant_eval_poison_reports_eval_error():
+    """An invariant whose evaluation errors on a reachable state (here:
+    out-of-domain sequence index) must be reported as an evaluation
+    error (__EvalError__), matching TLC's behavior, NOT as a violation
+    of the invariant itself (ADVICE r2: codegen poison routing)."""
+    from pulsar_tlaplus_tpu.frontend.parser import parse_module
+
+    mod = parse_module(
+        """---- MODULE poisoninv ----
+EXTENDS Naturals, Sequences
+VARIABLES x
+Init == x = 0
+Next == x < 2 /\\ x' = x + 1
+BadInv == <<5, 6>>[x] > 0
+====
+"""
+    )
+    spec = I.Spec(mod, {})
+    got, _cs = _check(
+        spec, invariants=("BadInv",),
+        sub_batch=8, visited_cap=1 << 10, frontier_cap=1 << 10,
+    )
+    # x = 0 is initial and indexes out of 1..2 -> eval error, not a
+    # "BadInv is violated" report
+    assert got.violation == "__EvalError__"
+
+
 @pytest.mark.parametrize(
     "name", ["subscription", "bookkeeper", "georeplication"]
 )
