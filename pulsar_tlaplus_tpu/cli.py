@@ -62,6 +62,13 @@ def _report(r, constants, wall: float) -> int:
         f"Finished in {wall:.1f}s "
         f"({r.states_per_sec:.0f} distinct states/sec)."
     )
+    fp_p = getattr(r, "fp_collision_prob", 0.0)
+    if fp_p:
+        # TLC prints the analogous line after every fingerprinted run
+        print(
+            "The calculated (optimistic) probability of a fingerprint "
+            f"collision at this state count is {fp_p:.3g}."
+        )
     if r.violation or r.deadlock:
         return 1
     if getattr(r, "truncated", False):

@@ -1,38 +1,48 @@
-"""Fully device-resident BFS checker — the round-2 throughput engine.
+"""Fully device-resident BFS checker — the round-3 throughput engine.
 
 Motivation (all numbers measured on the v5e chip behind the axon tunnel,
-``scripts/profile_expand2.py`` / ``scripts/profile_prims.py``):
+``scripts/profile_expand2.py`` / ``scripts/profile_lsm.py``):
 
 - one host<->device sync costs ~130 ms round-trip and bulk transfers run
-  at ~17-30 MB/s, so ANY per-chunk host involvement dominates wall time
-  (the round-1 engine paid ~5 syncs + MB-scale copies per 8k-state chunk);
-- device sorts are fast (~7 ns/element/operand at 8-16M elements) while
-  random-access gathers cost 15-55 ns/element (latency-bound) — the
-  round-1 hash-table probe loop spent ~1.1 s of every 1.12 s step in them;
-- dispatch is async and free: the host can enqueue work far ahead.
+  at ~17-30 MB/s, so ANY per-chunk host involvement dominates wall time;
+- device sorts are fast and bandwidth-bound while random-access gathers
+  are latency-bound — the design keeps every hot-path operation a sort,
+  a contiguous copy, or a contiguous-index scatter;
+- dispatch is async and free: the host enqueues work far ahead and
+  fetches one small stats vector per group of flushes.
 
-Design (SURVEY.md §2.2 E3/E4/E5/E7 re-architected):
+Round-3 redesign (VERDICT r2 #1: kill the per-sub-batch full-table
+re-sort).  The round-2 engine merged every expand sub-batch (``G*A``
+candidate lanes) into the visited set with a ``VCAP + G*A``-wide sort —
+sorting 33.5M visited keys to admit ~260k new states, ~8x per deep
+level.  Round 3 amortizes that merge:
 
-- **Everything lives in HBM**: the visited set (three sorted uint32 key
-  columns), the current/next frontier windows (packed states), and the
-  per-state ``(parent gid, action lane)`` trace log.
-- **Dedup is sort-merge**: concat the sorted visited columns with the
-  candidate keys, one 5-key ``lax.sort``, neighbor-compare — resolving
-  in-batch duplicates AND visited membership in the same pass; a stable
-  flag-sort compacts the merged visited set and the new states.  No
-  random access anywhere on the hot path.
-- **Invariants and deadlock are fused into the expand kernel** (evaluated
-  on candidate lanes, verdicts ride through the sort packed into the
-  payload word), exactly the "fused pmap" shape SURVEY.md §3.4 calls for.
-- The host fetches ONE packed stats vector per group of sub-batches
-  (a single ~130 ms round trip amortized over ~10^6-10^7 candidates) and
-  only dispatches: level loop, budget checks, and buffer growth.
+- **Candidate accumulator**: expand sub-batches append their candidate
+  keys + packed rows into an HBM accumulator (``ACAP = flush_factor *
+  G * A`` lanes); the visited merge ("flush") runs once per accumulator
+  fill, so the big sort is paid per ~ACAP candidates instead of per
+  sub-batch.  Sort traffic per state drops ~3x at bench shapes.
+- **Row store instead of frontier double-buffering**: all discovered
+  states live in one append-only packed-row store in gid order; a BFS
+  level is just a contiguous gid range, so expand windows are
+  contiguous slices (no gathers) and trace reconstruction reads rows
+  directly.  Memory at 50M+ states beats two full-level frontier
+  buffers, which is what capped the round-2 run at ~25M states.
+- **Fingerprint keys sized to the state** (``ops.dedup.KeySpec``):
+  exact 2-column keys for <64-bit states, exact 3-column for <96, and
+  64-bit murmur3 fingerprints (TLC's fingerprint-width regime, with
+  the collision probability reported like TLC does) for wide states —
+  one fewer sort operand everywhere vs round 2's fixed 3x32 keys.
+- **Invariants evaluate at append time on deduped new states only**
+  (round 2 evaluated them on every candidate lane and carried verdict
+  bits in the sort payload).  The payload is now a bare accumulator
+  index, which is what lets ACAP grow past the round-2 2^25 lane limit;
+  invariant work drops by the duplication factor for free.
 
-Counterexample traces: the log stores, per state, the parent gid and the
-action LANE that produced it (lanes are deterministic functions), so a
-trace is reconstructed by walking the parent chain on device (one fetch)
-and replaying lanes through the Python oracle on the host — no packed
-states are ever shipped back during the run.
+Counterexample traces: the per-state ``(parent gid, action lane)`` log
+is appended by the same scatter as the rows; a trace is reconstructed by
+walking the parent chain on device (one fetch) and replaying lanes
+through the model on the host (SURVEY.md §2.2-E7).
 """
 
 from __future__ import annotations
@@ -47,25 +57,25 @@ from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.ops import dedup
-from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
 BIG = jnp.int32(2**31 - 1)
-# payload word: low 25 bits candidate index, bits 25..30 invariant
-# verdicts, bit 31 the candidate tag (visited entries carry payload 0,
-# so the payload doubles as the visited-vs-candidate sort tie-breaker —
-# one fewer 42M-element operand in the dedup sort)
-IDX_BITS = 25
+# payload word: low 31 bits = accumulator slot index, bit 31 = the
+# candidate tag (visited entries carry payload 0, so the payload doubles
+# as the visited-vs-candidate sort tie-breaker)
 TAG_BIT = jnp.uint32(1 << 31)
+IDX_MASK = jnp.uint32((1 << 31) - 1)
 
 
 class DeviceChecker:
     """Level-synchronous BFS on one device with no hot-path host syncs.
 
-    Shapes are static per (visited-tier, frontier-tier): ``G`` frontier
-    states per sub-batch expand into ``NC = G * A`` candidate lanes; the
-    dedup sort is ``VCAP + NC`` wide.  The host grows VCAP/FCAP between
-    levels (geometric tiers, re-jitting per tier via the jit cache).
+    Shapes are static per capacity tier: ``G`` frontier states per
+    expand window produce ``NCs = G * A`` candidate lanes appended to
+    the accumulator; a flush merges ``VCAP + ACAP`` keys.  The host
+    grows VCAP / the row store between flushes (geometric tiers,
+    re-jitting per tier via the jit cache).
     """
 
     def __init__(
@@ -76,12 +86,15 @@ class DeviceChecker:
         sub_batch: int = 8192,
         expand_chunk: Optional[int] = None,
         visited_cap: int = 1 << 16,
-        frontier_cap: int = 1 << 15,
+        frontier_cap: Optional[int] = None,
         max_states: int = 1 << 26,
         time_budget_s: Optional[float] = None,
         progress: bool = False,
         metrics_path: Optional[str] = None,
         group: int = 4,
+        flush_factor: int = 1,
+        fp_bits: Optional[int] = None,
+        append_chunk: Optional[int] = None,
     ):
         self.model = model
         self.layout = model.layout
@@ -90,8 +103,17 @@ class DeviceChecker:
                 model, "default_invariants", pyeval.DEFAULT_INVARIANTS
             )
         self.invariant_names = tuple(invariants)
-        if len(self.invariant_names) > 31 - IDX_BITS:
-            raise ValueError("too many invariants for the payload word")
+        # compiled specs surface evaluation errors (TLC semantics) via
+        # the auto-invariant __EvalError__; an explicit invariant list
+        # must not silently drop it, or a reachable state whose
+        # invariant evaluation errors would pass unreported
+        model_invs = getattr(model, "invariants", None)
+        if (
+            model_invs is not None
+            and "__EvalError__" in model_invs
+            and "__EvalError__" not in self.invariant_names
+        ):
+            self.invariant_names += ("__EvalError__",)
         self.check_deadlock = check_deadlock
         self.A = model.A
         self.W = self.layout.W
@@ -99,15 +121,30 @@ class DeviceChecker:
         self.Fi = expand_chunk or min(sub_batch, 8192)
         if self.G % self.Fi:
             raise ValueError("sub_batch must be a multiple of expand_chunk")
-        self.NC = self.G * self.A
-        if self.NC > 1 << IDX_BITS:
-            raise ValueError("sub_batch * A exceeds payload index range")
+        self.NCs = self.G * self.A
+        self.FLUSH = flush_factor
+        self.ACAP = self.NCs * flush_factor
+        if self.ACAP > (1 << 31) - 1:
+            raise ValueError("sub_batch * A * flush_factor exceeds int32")
+        # append scan chunking: C blind DUS windows of SLc rows cover
+        # [n_visited, n_visited + APAD); capacity bounds use APAD
+        if append_chunk is not None:
+            self.SL = append_chunk
+        self.SLc = min(self.SL, self.ACAP)
+        self.C = -(-self.ACAP // self.SLc)
+        self.APAD = self.C * self.SLc
+        self.keys = KeySpec(self.layout.total_bits, self.W, fp_bits)
+        self.K = self.keys.ncols
         self.VCAP = self._round_cap(visited_cap)
-        self.FCAP = self._round_frontier(frontier_cap)
         self.SCAP = max_states
-        # trace logs grow geometrically toward SCAP (allocating
-        # max_states-sized logs up front would waste GBs on small runs)
-        self.LCAP = min(self._round_cap(visited_cap), max_states)
+        # the row store + trace logs grow geometrically toward SCAP
+        # (allocating max_states-sized stores up front would waste GBs
+        # on small runs); ``frontier_cap`` is kept as a sizing hint for
+        # compatibility with round-2 callers
+        self.LCAP = min(
+            self._round_cap(max(visited_cap, frontier_cap or 0, self.NCs)),
+            max(max_states, self.NCs),
+        )
         self.time_budget_s = time_budget_s
         self.progress = progress
         self.metrics_path = metrics_path
@@ -123,15 +160,6 @@ class DeviceChecker:
             n <<= 1
         return n
 
-    def _round_frontier(self, c: int) -> int:
-        # the append write-window is NC rows, so FCAP >= NC always; also
-        # a multiple of G (NC = G*A) so expand windows never run off the
-        # end of the buffer
-        n = self.NC
-        while n < c:
-            n *= 2
-        return n
-
     def _log(self, msg: str):
         if self.progress:
             import sys
@@ -141,32 +169,37 @@ class DeviceChecker:
     # -------------------------------------------------------- jitted ops
 
     def _slice_jit(self):
-        """Trivial FCAP-dependent slicer: frontier[FCAP,W], f_off ->
-        [G,W] window.  Keeping this separate means frontier-capacity
-        growth never recompiles the big expand graph."""
-        key = ("slice", self.FCAP)
+        """Trivial LCAP-dependent slicer: rows[LCAP,W], off -> [G,W]
+        window (a BFS level is a contiguous gid range of the row store).
+        Keeping this separate means row-store growth never recompiles
+        the big expand graph."""
+        key = ("slice", self.LCAP)
         if key in self._jits:
             return self._jits[key]
         G, W = self.G, self.W
 
-        def step(frontier, f_off):
-            return lax.dynamic_slice(frontier, (f_off, 0), (G, W))
+        def step(rows, off):
+            return lax.dynamic_slice(rows, (off, 0), (G, W))
 
         fn = jax.jit(step)
         self._jits[key] = fn
         return fn
 
     def _expand_jit(self):
-        """(window[G,W], f_off, n_live, dead_gid, gid_base) ->
-        (ck1, ck2, ck3 [NC], packed [NC,W], payload [NC], dead_gid').
-        ``f_off`` is the window's first row index in the frontier (for
-        liveness masking and deadlock gids); capacity-independent."""
+        """(ak cols, arows, window[G,W], f_off, n_live, dead_gid,
+        gid_base, acc_off) -> (ak', arows', dead_gid').
+
+        Expands one G-state window into ``NCs`` candidate lanes and
+        appends their key columns + packed rows into the accumulator at
+        ``acc_off``.  ``f_off`` is the window's first row index within
+        the current level (for liveness masking and deadlock gids);
+        capacity-independent apart from the fixed ACAP."""
         key = ("expand",)
         if key in self._jits:
             return self._jits[key]
         m, layout = self.model, self.layout
         Fi, A, W, G = self.Fi, self.A, self.W, self.G
-        inv_fns = [m.invariants[n] for n in self.invariant_names]
+        keyspec = self.keys
 
         def chunk(window, f_off, n_live, i):
             rows = lax.dynamic_slice(window, (i * Fi, 0), (Fi, W))
@@ -178,388 +211,212 @@ class DeviceChecker:
             packed = jax.vmap(jax.vmap(layout.pack))(succ)  # [Fi, A, W]
             fa = Fi * A
             packedf = packed.reshape(fa, W)
-            k1, k2, k3 = dedup.make_keys(packedf, layout.total_bits)
+            kcols = keyspec.make(packedf)
             vflat = valid.reshape(fa)
-            k1 = jnp.where(vflat, k1, SENTINEL)
-            k2 = jnp.where(vflat, k2, SENTINEL)
-            k3 = jnp.where(vflat, k3, SENTINEL)
-            vbits = jnp.zeros((Fi, A), jnp.uint32)
-            for b, fn in enumerate(inv_fns):
-                ok = jax.vmap(jax.vmap(fn))(succ)  # [Fi, A]
-                vbits = vbits | ((~ok & valid).astype(jnp.uint32) << b)
-            idx = (i * fa + jnp.arange(fa, dtype=jnp.uint32)).astype(
-                jnp.uint32
-            )
-            payload = idx | (vbits.reshape(fa) << IDX_BITS) | TAG_BIT
+            kcols = tuple(jnp.where(vflat, c, SENTINEL) for c in kcols)
             if self.check_deadlock:
                 stut = jax.vmap(m.stutter_enabled)(states)
                 dead_rows = live & ~jnp.any(valid, axis=1) & ~stut
                 didx = jnp.min(jnp.where(dead_rows, pos, BIG))
             else:
                 didx = BIG
-            return k1, k2, k3, packedf, payload, didx
+            return kcols, packedf, didx
 
-        def step(window, f_off, n_live, dead_gid, gid_base):
+        def step(*args):
+            ak = args[: self.K]
+            arows, window, f_off, n_live, dead_gid, gid_base, acc_off = args[
+                self.K:
+            ]
+
             def body(dead, i):
-                k1, k2, k3, p, pay, didx = chunk(window, f_off, n_live, i)
+                kcols, p, didx = chunk(window, f_off, n_live, i)
                 dead = jnp.minimum(
-                    dead,
-                    jnp.where(didx < BIG, gid_base + didx, BIG),
+                    dead, jnp.where(didx < BIG, gid_base + didx, BIG)
                 )
-                return dead, (k1, k2, k3, p, pay)
+                return dead, (kcols, p)
 
-            dead, outs = lax.scan(
+            dead, (kcols, packed) = lax.scan(
                 body, dead_gid, jnp.arange(G // Fi, dtype=jnp.int32)
             )
-            k1, k2, k3, packed, payload = outs
             nc = G * A
-            return (
-                k1.reshape(nc),
-                k2.reshape(nc),
-                k3.reshape(nc),
-                packed.reshape(nc, W),
-                payload.reshape(nc),
-                dead,
+            ak = tuple(
+                lax.dynamic_update_slice(
+                    akc, kc.reshape(nc), (acc_off,)
+                )
+                for akc, kc in zip(ak, kcols)
             )
+            arows = lax.dynamic_update_slice(
+                arows, packed.reshape(nc, W), (acc_off, 0)
+            )
+            return (*ak, arows, dead)
 
-        fn = jax.jit(step)
+        fn = jax.jit(step, donate_argnums=tuple(range(self.K + 1)))
         self._jits[key] = fn
         return fn
 
     def _init_jit(self):
-        """(f_off,) -> same contract as expand over NC init candidates."""
+        """(ak cols, arows, f_off, acc_off) -> (ak', arows').  Generates
+        ``NCs`` initial-state candidates (indices f_off..f_off+NCs) into
+        the accumulator — the mixed-radix counting kernel shape from
+        SURVEY.md §3.2."""
         key = ("init",)
         if key in self._jits:
             return self._jits[key]
         m, layout = self.model, self.layout
-        NC = self.NC
-        inv_fns = [m.invariants[n] for n in self.invariant_names]
+        NCs, W = self.NCs, self.W
+        keyspec = self.keys
         n_init = min(m.n_initial, (1 << 31) - 1)
 
-        def step(f_off):
-            idx = f_off + jnp.arange(NC, dtype=jnp.int32)
+        def step(*args):
+            ak = args[: self.K]
+            arows, f_off, acc_off = args[self.K:]
+            idx = f_off + jnp.arange(NCs, dtype=jnp.int32)
             states = jax.vmap(m.gen_initial)(idx)
             packed = jax.vmap(layout.pack)(states)
             valid = idx < n_init
-            k1, k2, k3 = dedup.make_keys(packed, layout.total_bits)
-            k1 = jnp.where(valid, k1, SENTINEL)
-            k2 = jnp.where(valid, k2, SENTINEL)
-            k3 = jnp.where(valid, k3, SENTINEL)
-            vbits = jnp.zeros((NC,), jnp.uint32)
-            for b, fn in enumerate(inv_fns):
-                ok = jax.vmap(fn)(states)
-                vbits = vbits | ((~ok & valid).astype(jnp.uint32) << b)
-            payload = (
-                jnp.arange(NC, dtype=jnp.uint32)
-                | (vbits << IDX_BITS)
-                | TAG_BIT
+            kcols = keyspec.make(packed)
+            kcols = tuple(jnp.where(valid, c, SENTINEL) for c in kcols)
+            ak = tuple(
+                lax.dynamic_update_slice(akc, kc, (acc_off,))
+                for akc, kc in zip(ak, kcols)
             )
-            return k1, k2, k3, packed, payload, BIG
+            arows = lax.dynamic_update_slice(arows, packed, (acc_off, 0))
+            return (*ak, arows)
 
-        fn = jax.jit(step)
+        fn = jax.jit(step, donate_argnums=tuple(range(self.K + 1)))
         self._jits[key] = fn
         return fn
 
-    def _dedup_jit(self):
-        """Sort-merge dedup: returns updated visited columns, n_new, and
-        the compacted candidate payloads of the new states in gid order."""
-        key = ("dedup", self.VCAP)
+    def _flush_jit(self):
+        """Sort-merge the accumulator into the visited set: (vk cols,
+        ak cols, n_acc) -> (vk' cols, n_new, new_pay[ACAP]).
+
+        One unstable ``K+1``-operand sort resolves in-accumulator
+        duplicates AND visited membership in the same pass (payload 0 =
+        visited orders before same-key candidates); a stable flag-sort
+        compacts the merged visited set; a stable 2-operand flag-sort
+        compacts the surviving candidates' payloads to the front."""
+        key = ("flush", self.VCAP)
         if key in self._jits:
             return self._jits[key]
-        VCAP, NC = self.VCAP, self.NC
+        ACAP, K = self.ACAP, self.K
 
-        def step(vk1, vk2, vk3, ck1, ck2, ck3, payload):
-            # visited entries carry payload 0 and candidates have TAG_BIT
-            # set, so the payload column alone orders visited before
-            # same-key candidates — no separate tag operand in the sort
-            pay = jnp.concatenate(
-                [jnp.zeros((VCAP,), jnp.uint32), payload]
+        def step(*args):
+            vk = args[:K]
+            ak = args[K: 2 * K]
+            n_acc = args[2 * K]
+            lanei = jnp.arange(ACAP, dtype=jnp.int32)
+            amask = lanei < n_acc  # stale tail from a previous fill
+            ccols = tuple(
+                jnp.where(amask, ac, SENTINEL) for ac in ak
             )
-            c1 = jnp.concatenate([vk1, ck1])
-            c2 = jnp.concatenate([vk2, ck2])
-            c3 = jnp.concatenate([vk3, ck3])
-            s1, s2, s3, sp = lax.sort(
-                (c1, c2, c3, pay), num_keys=4, is_stable=False
-            )
-            st = sp >> 31  # 1 = candidate, 0 = visited
-            sent = (s1 == SENTINEL) & (s2 == SENTINEL) & (s3 == SENTINEL)
-            prev_same = jnp.zeros((VCAP + NC,), jnp.bool_)
-            prev_same = prev_same.at[1:].set(
-                (s1[1:] == s1[:-1])
-                & (s2[1:] == s2[:-1])
-                & (s3[1:] == s3[:-1])
-            )
-            new_flag = (st == 1) & ~sent & ~prev_same
-            keep = ~sent & ((st == 0) | new_flag)
-            n_new = jnp.sum(new_flag.astype(jnp.int32))
-            # blank dropped entries to SENTINEL *before* compacting: their
-            # key values must not survive into the visited columns, or the
-            # table silently fills with phantom duplicates
-            kk = (~keep).astype(jnp.uint32)
-            m1 = jnp.where(keep, s1, SENTINEL)
-            m2 = jnp.where(keep, s2, SENTINEL)
-            m3 = jnp.where(keep, s3, SENTINEL)
-            _, v1, v2, v3 = lax.sort(
-                (kk, m1, m2, m3), num_keys=1, is_stable=True
+            cpay = lanei.astype(jnp.uint32) | TAG_BIT
+            vk2, n_new, sp, new_flag = dedup.merge_new_keys(
+                vk, ccols, cpay
             )
             nn = (~new_flag).astype(jnp.uint32)
             _, new_pay = lax.sort((nn, sp), num_keys=1, is_stable=True)
-            return (
-                v1[:VCAP],
-                v2[:VCAP],
-                v3[:VCAP],
-                n_new,
-                new_pay[:NC],
+            return (*vk2, n_new, new_pay[:ACAP])
+
+        fn = jax.jit(step, donate_argnums=tuple(range(self.K)))
+        self._jits[key] = fn
+        return fn
+
+    # gather/DUS chunk for the append scan: bounds the transient tiled
+    # buffer a [n, W] gather result materializes on TPU (the minor dim
+    # pads to 128 in the tiled layout, so a full-ACAP gather would be
+    # ACAP*128*4B — 17 GB at bench shapes; measured, profile_lsm.py)
+    SL = 1 << 20
+
+    def _append_jit(self, is_init: bool):
+        """Append the flush's new states: chunked scan that gathers each
+        SL-slice of new rows from the accumulator, derives parent gids /
+        action lanes, evaluates the invariants on exactly the new states
+        (deduped — round 2 paid this on every candidate lane), and
+        writes rows + logs with blind full-window DUS chunks.
+
+        The window [n_visited, n_visited + ACAP) is written whole; the
+        tail beyond n_new is garbage that the NEXT flush's window
+        overwrites before it can ever be read (reads only touch
+        [0, n_visited)).  The run loop guarantees ``n_visited + ACAP <=
+        LCAP`` before dispatching, so no DUS can clamp."""
+        key = ("append", self.LCAP, is_init)
+        if key in self._jits:
+            return self._jits[key]
+        A = self.A
+        SL, C = self.SLc, self.C
+        layout = self.layout
+        inv_fns = [self.model.invariants[n] for n in self.invariant_names]
+        n_inv = len(self.invariant_names)
+
+        def step(rows_store, parent_log, lane_log, arows, new_pay, n_new,
+                 n_visited, viol, acc_base):
+            if C * SL > new_pay.shape[0]:
+                # the scan covers C*SL = APAD >= ACAP lanes; pad so the
+                # last chunk's dynamic_slice can never clamp and replay
+                # earlier payloads into live tail lanes
+                new_pay = jnp.concatenate(
+                    [
+                        new_pay,
+                        jnp.zeros((C * SL - new_pay.shape[0],), jnp.uint32),
+                    ]
+                )
+
+            def chunk(carry, c):
+                rows_store, parent_log, lane_log, viol = carry
+                lanei = c * SL + jnp.arange(SL, dtype=jnp.int32)
+                live = lanei < n_new
+                pay = lax.dynamic_slice(new_pay, (c * SL,), (SL,))
+                idx = (pay & IDX_MASK).astype(jnp.int32)
+                # dead lanes gather row 0 (cache-resident), so gather
+                # cost tracks n_new, not ACAP
+                src = arows[jnp.where(live, idx, 0)]
+                if is_init:
+                    par = -1 - (acc_base + idx)
+                    lane = jnp.zeros((SL,), jnp.int32)
+                else:
+                    par = acc_base + idx // A
+                    lane = idx % A
+                par = jnp.where(live, par, 0)
+                lane = jnp.where(live, lane, 0)
+                gids = n_visited + lanei
+                if n_inv:
+                    states = jax.vmap(layout.unpack)(src)
+                    vnew = []
+                    for fn in inv_fns:
+                        ok = jax.vmap(fn)(states)
+                        bad = live & ~ok
+                        vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
+                    viol = jnp.minimum(viol, jnp.stack(vnew))
+                off = n_visited + c * SL
+                rows_store = lax.dynamic_update_slice(
+                    rows_store, src, (off, 0)
+                )
+                parent_log = lax.dynamic_update_slice(
+                    parent_log, par, (off,)
+                )
+                lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
+                return (rows_store, parent_log, lane_log, viol), None
+
+            (rows_store, parent_log, lane_log, viol), _ = lax.scan(
+                chunk,
+                (rows_store, parent_log, lane_log, viol),
+                jnp.arange(C, dtype=jnp.int32),
             )
+            return rows_store, parent_log, lane_log, n_visited + n_new, viol
 
         fn = jax.jit(step, donate_argnums=(0, 1, 2))
         self._jits[key] = fn
         return fn
-
-    def _append_core_jit(self, is_init: bool):
-        """Capacity-independent half of the append: gather the new
-        states' packed rows, derive parent gids / action lanes, fold
-        invariant verdicts into the viol vector."""
-        key = ("appcore", is_init)
-        if key in self._jits:
-            return self._jits[key]
-        NC, A = self.NC, self.A
-        n_inv = len(self.invariant_names)
-
-        def step(n_visited, viol, packed, new_pay, n_new, parent_base):
-            lane_idx = jnp.arange(NC, dtype=jnp.int32)
-            live = lane_idx < n_new
-            idxs = (new_pay & jnp.uint32((1 << IDX_BITS) - 1)).astype(
-                jnp.int32
-            )
-            vbits = (new_pay >> IDX_BITS) & jnp.uint32(
-                (1 << (31 - IDX_BITS)) - 1
-            )
-            rows = packed[jnp.where(live, idxs, 0)]
-            if is_init:
-                par = -1 - (parent_base + idxs)
-                lane = jnp.zeros((NC,), jnp.int32)
-            else:
-                par = parent_base + idxs // A
-                lane = idxs % A
-            par = jnp.where(live, par, 0)
-            lane = jnp.where(live, lane, 0)
-            gids = n_visited + lane_idx
-            vnew = []
-            for b in range(n_inv):
-                vb = live & (((vbits >> b) & 1) == 1)
-                vnew.append(jnp.min(jnp.where(vb, gids, BIG)))
-            viol = jnp.minimum(viol, jnp.stack(vnew)) if n_inv else viol
-            return rows, par, lane, n_visited + n_new, viol
-
-        fn = jax.jit(step)
-        self._jits[key] = fn
-        return fn
-
-    def _write_jit(self):
-        """Trivial capacity-dependent writer: dynamic_update_slice the new
-        rows into the next-frontier window and the par/lane columns into
-        the trace logs.  Compiles in milliseconds, so FCAP growth never
-        recompiles the big graphs."""
-        key = ("write", self.FCAP, self.LCAP)
-        if key in self._jits:
-            return self._jits[key]
-
-        def step(nxt, n_next, parent_log, lane_log, n_visited, rows,
-                 par, lane, n_new):
-            nxt = lax.dynamic_update_slice(nxt, rows, (n_next, 0))
-            parent_log = lax.dynamic_update_slice(
-                parent_log, par, (n_visited,)
-            )
-            lane_log = lax.dynamic_update_slice(lane_log, lane, (n_visited,))
-            return nxt, n_next + n_new, parent_log, lane_log
-
-        fn = jax.jit(step, donate_argnums=(0, 2, 3))
-        self._jits[key] = fn
-        return fn
-
-    SEED_CHUNK = 1 << 15
-    SEED_VCAP = 1 << 16
-
-    def _seed_jits(self):
-        """Small-shape pipeline for host-seeded warm starts: the seed
-        prefix is tiny, so it must not pay the full-size (data-
-        independent) sort/expand latency of the main kernels.  Compiles
-        in seconds (sort lowering scales with width)."""
-        key = ("seedmerge",)
-        if key in self._jits:
-            return self._jits[key]
-        NCs, VCs = self.SEED_CHUNK, self.SEED_VCAP
-        layout = self.layout
-        m = self.model
-        inv_fns = [m.invariants[n] for n in self.invariant_names]
-        n_inv = len(self.invariant_names)
-
-        def merge(vk1, vk2, vk3, rows, n_valid, n_visited, viol, gid_base):
-            k1, k2, k3 = dedup.make_keys(rows, layout.total_bits)
-            lane = jnp.arange(NCs, dtype=jnp.int32)
-            valid = lane < n_valid
-            k1 = jnp.where(valid, k1, SENTINEL)
-            k2 = jnp.where(valid, k2, SENTINEL)
-            k3 = jnp.where(valid, k3, SENTINEL)
-            pay = lane.astype(jnp.uint32) | TAG_BIT
-            c1 = jnp.concatenate([vk1, k1])
-            c2 = jnp.concatenate([vk2, k2])
-            c3 = jnp.concatenate([vk3, k3])
-            cp = jnp.concatenate([jnp.zeros((VCs,), jnp.uint32), pay])
-            s1, s2, s3, sp = lax.sort(
-                (c1, c2, c3, cp), num_keys=4, is_stable=False
-            )
-            sent = (s1 == SENTINEL) & (s2 == SENTINEL) & (s3 == SENTINEL)
-            prev_same = jnp.zeros((VCs + NCs,), jnp.bool_)
-            prev_same = prev_same.at[1:].set(
-                (s1[1:] == s1[:-1])
-                & (s2[1:] == s2[:-1])
-                & (s3[1:] == s3[:-1])
-            )
-            new_flag = ((sp >> 31) == 1) & ~sent & ~prev_same
-            keep = ~sent & (((sp >> 31) == 0) | new_flag)
-            kk = (~keep).astype(jnp.uint32)
-            m1 = jnp.where(keep, s1, SENTINEL)
-            m2 = jnp.where(keep, s2, SENTINEL)
-            m3 = jnp.where(keep, s3, SENTINEL)
-            _, v1, v2, v3 = lax.sort(
-                (kk, m1, m2, m3), num_keys=1, is_stable=True
-            )
-            # fused invariant check on the seed states (discovery-time
-            # semantics, same as the main expand path)
-            states = jax.vmap(layout.unpack)(rows)
-            vnew = []
-            for fn in inv_fns:
-                ok = jax.vmap(fn)(states)
-                bad = valid & ~ok
-                vnew.append(
-                    jnp.min(jnp.where(bad, gid_base + lane, BIG))
-                )
-            if n_inv:
-                viol = jnp.minimum(viol, jnp.stack(vnew))
-            n_new = jnp.sum(new_flag.astype(jnp.int32))
-            return (
-                v1[:VCs], v2[:VCs], v3[:VCs],
-                n_visited + n_new, viol,
-            )
-
-        fn = jax.jit(merge, donate_argnums=(0, 1, 2))
-        self._jits[key] = fn
-        return fn
-
-    def _seed_write_jit(self):
-        key = ("seedwrite", self.FCAP, self.LCAP)
-        if key in self._jits:
-            return self._jits[key]
-
-        def write(nxt, n_next, parent_log, lane_log, off, rows, par, lane,
-                  count):
-            nxt = lax.dynamic_update_slice(nxt, rows, (n_next, 0))
-            parent_log = lax.dynamic_update_slice(parent_log, par, (off,))
-            lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
-            return nxt, n_next + count, parent_log, lane_log
-
-        fn = jax.jit(write, donate_argnums=(0, 2, 3))
-        self._jits[key] = fn
-        return fn
-
-    def _load_seed(self, bufs, st, seed):
-        """Bulk-load a host-enumerated BFS prefix: packed states in BFS
-        (= gid) order with parent gids (roots: ``-1 - init_idx``) and
-        action lanes, plus per-level sizes.  The caller guarantees the
-        states are distinct, level-complete, and deadlock-free (they
-        were fully expanded by the host).  Returns level_sizes."""
-        rows, parents, lanes, lsizes = seed
-        rows = np.ascontiguousarray(rows, np.uint32)
-        parents = np.ascontiguousarray(parents, np.int32)
-        lanes = np.ascontiguousarray(lanes, np.int32)
-        n = len(rows)
-        if sum(lsizes) != n:
-            raise ValueError("seed level sizes do not sum to the state count")
-        if n > self.SEED_VCAP // 2 or n > self.SCAP:
-            raise ValueError(f"seed too large ({n} states)")
-        # seed windows are SEED_CHUNK rows, so every buffer must admit
-        # one full chunk past the worst-case write offset: frontier
-        # writes start at n_next (up to the last level's size, < n) and
-        # span SEED_CHUNK padded rows — if FCAP were smaller the
-        # dynamic_update_slice would clamp and silently overwrite
-        # earlier frontier rows (same guard the logs get below)
-        self._grow_visited(bufs, max(n + self.NC, self.SEED_VCAP))
-        self._grow_frontier(
-            bufs, max(n + self.SEED_CHUNK, n + self.NC)
-        )
-        self._grow_logs(
-            bufs, max(n + self.NC, n + self.SEED_CHUNK - self.NC)
-        )
-        if self.LCAP + self.NC < n + self.SEED_CHUNK:
-            raise ValueError(
-                "seed too large for max_states: need max_states >= "
-                f"{n + self.SEED_CHUNK - self.NC} (the padded seed write "
-                "window must never clamp)"
-            )
-        merge = self._seed_jits()
-        write = self._seed_write_jit()
-        NCs = self.SEED_CHUNK
-        W = self.W
-        vks = tuple(
-            jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
-            for _ in range(3)
-        )
-        n_vis = jnp.int32(0)
-        off = 0
-        last = lsizes[-1]
-        for li, count in enumerate(lsizes):
-            if li == len(lsizes) - 1:
-                st["n_next"] = jnp.int32(0)  # frontier = last seed level
-            for c0 in range(0, count, NCs):
-                cn = min(NCs, count - c0)
-                chunk = np.zeros((NCs, W), np.uint32)
-                chunk[:cn] = rows[off + c0: off + c0 + cn]
-                par = np.zeros((NCs,), np.int32)
-                par[:cn] = parents[off + c0: off + c0 + cn]
-                lan = np.zeros((NCs,), np.int32)
-                lan[:cn] = lanes[off + c0: off + c0 + cn]
-                jrows = jnp.asarray(chunk)
-                vk1, vk2, vk3, n_vis, st["viol"] = merge(
-                    *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
-                    jnp.int32(off + c0),
-                )
-                vks = (vk1, vk2, vk3)
-                (
-                    bufs["next"], st["n_next"], bufs["parent"],
-                    bufs["lane"],
-                ) = write(
-                    bufs["next"], st["n_next"], bufs["parent"],
-                    bufs["lane"], jnp.int32(off + c0), jrows,
-                    jnp.asarray(par), jnp.asarray(lan), jnp.int32(cn),
-                )
-            off += count
-        if int(np.asarray(n_vis)) != n:
-            raise ValueError(
-                "seed states are not all distinct "
-                f"({int(np.asarray(n_vis))} of {n} unique)"
-            )
-        # hand the small sorted columns to the main engine (SENTINEL pad)
-        bufs["vk"] = tuple(
-            jnp.concatenate(
-                [col, jnp.full((self.VCAP - self.SEED_VCAP,), SENTINEL,
-                               jnp.uint32)]
-            )
-            for col in vks
-        )
-        st["n_visited"] = jnp.int32(n)
-        st["n_next"] = jnp.int32(last)
-        return [int(x) for x in lsizes]
 
     def _stats_jit(self):
         key = ("stats",)
         if key in self._jits:
             return self._jits[key]
 
-        def step(n_visited, n_next, dead_gid, viol):
+        def step(n_visited, dead_gid, viol):
             return jnp.concatenate(
-                [jnp.stack([n_visited, n_next, dead_gid]), viol]
+                [jnp.stack([n_visited, dead_gid]), viol]
             )
 
         fn = jax.jit(step)
@@ -593,6 +450,142 @@ class DeviceChecker:
         self._jits[key] = fn
         return fn
 
+    # ----------------------------------------------- host-seeded starts
+
+    SEED_CHUNK = 1 << 15
+    SEED_VCAP = 1 << 16
+
+    def _seed_merge_jit(self):
+        """Small-shape merge for host-seeded warm starts: the seed
+        prefix is tiny, so it must not pay the full-size (data-
+        independent) sort latency of the main flush kernel."""
+        key = ("seedmerge",)
+        if key in self._jits:
+            return self._jits[key]
+        NCs, VCs, K = self.SEED_CHUNK, self.SEED_VCAP, self.K
+        layout = self.layout
+        m = self.model
+        inv_fns = [m.invariants[n] for n in self.invariant_names]
+        n_inv = len(self.invariant_names)
+        keyspec = self.keys
+
+        def merge(*args):
+            vk = args[:K]
+            rows, n_valid, n_visited, viol, gid_base = args[K:]
+            kcols = keyspec.make(rows)
+            lane = jnp.arange(NCs, dtype=jnp.int32)
+            valid = lane < n_valid
+            kcols = tuple(jnp.where(valid, c, SENTINEL) for c in kcols)
+            cpay = lane.astype(jnp.uint32) | TAG_BIT
+            vk2, n_new, _sp, _nf = dedup.merge_new_keys(vk, kcols, cpay)
+            # fused invariant check on the seed states (discovery-time
+            # semantics, same as the main append path)
+            if n_inv:
+                states = jax.vmap(layout.unpack)(rows)
+                vnew = []
+                for fn in inv_fns:
+                    ok = jax.vmap(fn)(states)
+                    bad = valid & ~ok
+                    vnew.append(
+                        jnp.min(jnp.where(bad, gid_base + lane, BIG))
+                    )
+                viol = jnp.minimum(viol, jnp.stack(vnew))
+            return (*vk2, n_visited + n_new, viol)
+
+        fn = jax.jit(merge, donate_argnums=tuple(range(self.K)))
+        self._jits[key] = fn
+        return fn
+
+    def _seed_write_jit(self):
+        """Seed rows/logs land via exact-size DUS windows (the host
+        knows every seed count, so no clamping is possible and no
+        scatter is needed)."""
+        key = ("seedwrite", self.LCAP)
+        if key in self._jits:
+            return self._jits[key]
+
+        def write(rows_store, parent_log, lane_log, rows, par, lane, off):
+            rows_store = lax.dynamic_update_slice(
+                rows_store, rows, (off, 0)
+            )
+            parent_log = lax.dynamic_update_slice(parent_log, par, (off,))
+            lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
+            return rows_store, parent_log, lane_log
+
+        fn = jax.jit(write, donate_argnums=(0, 1, 2))
+        self._jits[key] = fn
+        return fn
+
+    def _load_seed(self, bufs, st, seed):
+        """Bulk-load a host-enumerated BFS prefix: packed states in BFS
+        (= gid) order with parent gids (roots: ``-1 - init_idx``) and
+        action lanes, plus per-level sizes.  The caller guarantees the
+        states are distinct, level-complete, and deadlock-free (they
+        were fully expanded by the host).  Returns level_sizes."""
+        rows, parents, lanes, lsizes = seed
+        rows = np.ascontiguousarray(rows, np.uint32)
+        parents = np.ascontiguousarray(parents, np.int32)
+        lanes = np.ascontiguousarray(lanes, np.int32)
+        n = len(rows)
+        if sum(lsizes) != n:
+            raise ValueError("seed level sizes do not sum to the state count")
+        if n > self.SEED_VCAP // 2 or n > self.SCAP:
+            raise ValueError(f"seed too large ({n} states)")
+        self._grow_visited(bufs, max(n + self.ACAP, self.SEED_VCAP))
+        # seed writes are SEED_CHUNK-padded DUS windows starting at
+        # offsets up to n, so the store must admit one full chunk past
+        # the worst-case write start or the DUS would clamp and corrupt
+        self._grow_store(bufs, n + self.SEED_CHUNK)
+        merge = self._seed_merge_jit()
+        write = self._seed_write_jit()
+        NCs = self.SEED_CHUNK
+        W = self.W
+        vks = tuple(
+            jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
+            for _ in range(self.K)
+        )
+        n_vis = jnp.int32(0)
+        off = 0
+        for count in lsizes:
+            for c0 in range(0, count, NCs):
+                cn = min(NCs, count - c0)
+                chunk = np.zeros((NCs, W), np.uint32)
+                chunk[:cn] = rows[off + c0: off + c0 + cn]
+                par = np.zeros((NCs,), np.int32)
+                par[:cn] = parents[off + c0: off + c0 + cn]
+                lan = np.zeros((NCs,), np.int32)
+                lan[:cn] = lanes[off + c0: off + c0 + cn]
+                jrows = jnp.asarray(chunk)
+                out = merge(
+                    *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
+                    jnp.int32(off + c0),
+                )
+                vks = out[: self.K]
+                n_vis, st["viol"] = out[self.K], out[self.K + 1]
+                (
+                    bufs["rows"], bufs["parent"], bufs["lane"],
+                ) = write(
+                    bufs["rows"], bufs["parent"], bufs["lane"],
+                    jrows, jnp.asarray(par), jnp.asarray(lan),
+                    jnp.int32(off + c0),
+                )
+            off += count
+        if int(np.asarray(n_vis)) != n:
+            raise ValueError(
+                "seed states are not all distinct "
+                f"({int(np.asarray(n_vis))} of {n} unique)"
+            )
+        # hand the small sorted columns to the main engine (SENTINEL pad)
+        bufs["vk"] = tuple(
+            jnp.concatenate(
+                [col, jnp.full((self.VCAP - self.SEED_VCAP,), SENTINEL,
+                               jnp.uint32)]
+            )
+            for col in vks
+        )
+        st["n_visited"] = jnp.int32(n)
+        return [int(x) for x in lsizes]
+
     # ------------------------------------------------------------ growth
 
     def _grow_visited(self, bufs, need: int):
@@ -606,25 +599,19 @@ class DeviceChecker:
             )
             self.VCAP *= 2
 
-    def _grow_frontier(self, bufs, need: int):
-        while self.FCAP < need:
-            pad = self.FCAP
-            z = jnp.zeros((pad, self.W), jnp.uint32)
-            bufs["frontier"] = jnp.concatenate([bufs["frontier"], z])
-            bufs["next"] = jnp.concatenate([bufs["next"], z])
-            self.FCAP *= 2
-
-    def _grow_logs(self, bufs, need: int):
-        while self.LCAP < min(need, self.SCAP):
-            new = min(self.LCAP * 2, self.SCAP)
-            pad = new - self.LCAP
+    def _grow_store(self, bufs, need: int):
+        while self.LCAP < need:
+            pad = self.LCAP
+            bufs["rows"] = jnp.concatenate(
+                [bufs["rows"], jnp.zeros((pad, self.W), jnp.uint32)]
+            )
             bufs["parent"] = jnp.concatenate(
                 [bufs["parent"], jnp.zeros((pad,), jnp.int32)]
             )
             bufs["lane"] = jnp.concatenate(
                 [bufs["lane"], jnp.zeros((pad,), jnp.int32)]
             )
-            self.LCAP = new
+            self.LCAP *= 2
 
     # --------------------------------------------------------------- run
 
@@ -635,6 +622,7 @@ class DeviceChecker:
         t0 = time.time()
         z = jnp.zeros
         n_inv = len(self.invariant_names)
+        K = self.K
 
         def drain(o):
             # block_until_ready is unreliable on the tunnel backend
@@ -644,74 +632,72 @@ class DeviceChecker:
             leaf = jax.tree.leaves(o)[0]
             np.asarray(jnp.ravel(leaf)[0])
 
-        drain(self._init_jit()(jnp.int32(0)))
-        ck = tuple(
-            jnp.full((self.NC,), SENTINEL, jnp.uint32) for _ in range(3)
-        )
-        vk = tuple(
-            jnp.full((self.VCAP,), SENTINEL, jnp.uint32) for _ in range(3)
-        )
-        drain(self._dedup_jit()(*vk, *ck, z((self.NC,), jnp.uint32)))
-        del vk, ck
-        for is_init in (True, False):
-            drain(
-                self._append_core_jit(is_init)(
-                    jnp.int32(0), jnp.full((n_inv,), int(BIG), jnp.int32),
-                    z((self.NC, self.W), jnp.uint32),
-                    z((self.NC,), jnp.uint32),
-                    jnp.int32(0), jnp.int32(0),
-                )
+        def acc():
+            return (
+                tuple(
+                    jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
+                    for _ in range(K)
+                ),
+                z((self.ACAP, self.W), jnp.uint32),
             )
-        drain(
-            self._write_jit()(
-                z((self.FCAP, self.W), jnp.uint32), jnp.int32(0),
-                z((self.LCAP + self.NC,), jnp.int32),
-                z((self.LCAP + self.NC,), jnp.int32),
-                jnp.int32(0), z((self.NC, self.W), jnp.uint32),
-                z((self.NC,), jnp.int32), z((self.NC,), jnp.int32),
+
+        ak, arows = acc()
+        out = self._init_jit()(*ak, arows, jnp.int32(0), jnp.int32(0))
+        drain(out)
+        ak, arows = out[:K], out[K]
+        rows_buf = z((self.LCAP, self.W), jnp.uint32)
+        window = self._slice_jit()(rows_buf, jnp.int32(0))
+        del rows_buf
+        out = self._expand_jit()(
+            *ak, arows, window, jnp.int32(0), jnp.int32(0), BIG,
+            jnp.int32(0), jnp.int32(0),
+        )
+        drain(out)
+        ak, arows = out[:K], out[K]
+        del window
+        vk = tuple(
+            jnp.full((self.VCAP,), SENTINEL, jnp.uint32) for _ in range(K)
+        )
+        out = self._flush_jit()(*vk, *ak, jnp.int32(0))
+        drain(out)
+        del vk
+        new_pay = out[K + 1]
+        viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
+        for is_init in (True, False):
+            app = self._append_jit(is_init)(
+                z((self.LCAP, self.W), jnp.uint32),
+                z((self.LCAP,), jnp.int32), z((self.LCAP,), jnp.int32),
+                arows, new_pay, jnp.int32(0), jnp.int32(0), viol0,
                 jnp.int32(0),
             )
-        )
-        frontier = z((self.FCAP, self.W), jnp.uint32)
-        window = self._slice_jit()(frontier, jnp.int32(0))
-        del frontier
-        drain(
-            self._expand_jit()(
-                window, jnp.int32(0), jnp.int32(0), BIG, jnp.int32(0)
-            )
-        )
-        del window
-        drain(
-            self._stats_jit()(
-                jnp.int32(0), jnp.int32(0), BIG,
-                jnp.full((n_inv,), int(BIG), jnp.int32),
-            )
-        )
+            drain(app)
+            del app
+        del ak, arows, new_pay
+        drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
         drain(
             self._chain_jit(4)(
-                z((self.LCAP + self.NC,), jnp.int32),
-                z((self.LCAP + self.NC,), jnp.int32), jnp.int32(-1),
+                z((self.LCAP,), jnp.int32),
+                z((self.LCAP,), jnp.int32), jnp.int32(-1),
             )
         )
         if seed:
-            merge = self._seed_jits()
+            merge = self._seed_merge_jit()
             write = self._seed_write_jit()
             vks = tuple(
                 jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
-                for _ in range(3)
+                for _ in range(K)
             )
             drain(
                 merge(
                     *vks, z((self.SEED_CHUNK, self.W), jnp.uint32),
-                    jnp.int32(0), jnp.int32(0),
-                    jnp.full((n_inv,), int(BIG), jnp.int32), jnp.int32(0),
+                    jnp.int32(0), jnp.int32(0), viol0, jnp.int32(0),
                 )
             )
             drain(
                 write(
-                    z((self.FCAP, self.W), jnp.uint32), jnp.int32(0),
-                    z((self.LCAP + self.NC,), jnp.int32),
-                    z((self.LCAP + self.NC,), jnp.int32), jnp.int32(0),
+                    z((self.LCAP, self.W), jnp.uint32),
+                    z((self.LCAP,), jnp.int32),
+                    z((self.LCAP,), jnp.int32),
                     z((self.SEED_CHUNK, self.W), jnp.uint32),
                     z((self.SEED_CHUNK,), jnp.int32),
                     z((self.SEED_CHUNK,), jnp.int32), jnp.int32(0),
@@ -725,28 +711,27 @@ class DeviceChecker:
     def run(self, seed=None) -> CheckerResult:
         """``seed``: optional host-enumerated BFS prefix
         ``(packed_rows, parent_gids, action_lanes, level_sizes)`` —
-        see :meth:`_load_seed`.  The engine bulk-loads it through the
-        small-shape pipeline and starts expanding at the last seed
-        level, skipping the full-size kernel latency that tiny early
-        levels would otherwise pay."""
+        see :meth:`_load_seed`."""
         t0 = time.time()
         m = self.model
         n_inv = len(self.invariant_names)
-        # logs get one extra NC-window of slack so the last
-        # dynamic_update_slice before the budget stop never clamps
+        K = self.K
         bufs = {
             "vk": tuple(
                 jnp.full((self.VCAP,), SENTINEL, jnp.uint32)
-                for _ in range(3)
+                for _ in range(K)
             ),
-            "frontier": jnp.zeros((self.FCAP, self.W), jnp.uint32),
-            "next": jnp.zeros((self.FCAP, self.W), jnp.uint32),
-            "parent": jnp.zeros((self.LCAP + self.NC,), jnp.int32),
-            "lane": jnp.zeros((self.LCAP + self.NC,), jnp.int32),
+            "ak": tuple(
+                jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
+                for _ in range(K)
+            ),
+            "arows": jnp.zeros((self.ACAP, self.W), jnp.uint32),
+            "rows": jnp.zeros((self.LCAP, self.W), jnp.uint32),
+            "parent": jnp.zeros((self.LCAP,), jnp.int32),
+            "lane": jnp.zeros((self.LCAP,), jnp.int32),
         }
         st = {
             "n_visited": jnp.int32(0),
-            "n_next": jnp.int32(0),
             "dead_gid": BIG,
             "viol": jnp.full((n_inv,), int(BIG), jnp.int32),
         }
@@ -758,41 +743,34 @@ class DeviceChecker:
         def fetch():
             tf = time.time()
             out = np.asarray(
-                stats_fn(
-                    st["n_visited"], st["n_next"], st["dead_gid"],
-                    st["viol"],
-                )
+                stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
             )
             self._host_wait_s += time.time() - tf
             return out
 
-        def dispatch(gen_fn, gen_args, parent_base, is_init):
-            ck1, ck2, ck3, packed, payload, dead = gen_fn(*gen_args)
-            st["dead_gid"] = dead
-            vk1, vk2, vk3, n_new, new_pay = self._dedup_jit()(
-                *bufs["vk"], ck1, ck2, ck3, payload
+        def flush(n_acc: int, acc_base: int, is_init: bool):
+            """Dispatch the merge + append for the current accumulator
+            fill (``n_acc`` valid lanes covering source rows starting
+            at ``acc_base``)."""
+            out = self._flush_jit()(
+                *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
             )
-            bufs["vk"] = (vk1, vk2, vk3)
-            rows, par, lane, n_vis2, viol2 = self._append_core_jit(is_init)(
-                st["n_visited"], st["viol"], packed, new_pay, n_new,
-                jnp.int32(parent_base),
-            )
+            bufs["vk"] = out[:K]
+            n_new, new_pay = out[K], out[K + 1]
             (
-                bufs["next"], st["n_next"], bufs["parent"], bufs["lane"],
-            ) = self._write_jit()(
-                bufs["next"], st["n_next"], bufs["parent"], bufs["lane"],
-                st["n_visited"], rows, par, lane, n_new,
+                bufs["rows"], bufs["parent"], bufs["lane"],
+                st["n_visited"], st["viol"],
+            ) = self._append_jit(is_init)(
+                bufs["rows"], bufs["parent"], bufs["lane"],
+                bufs["arows"], new_pay, n_new, st["n_visited"],
+                st["viol"], jnp.int32(acc_base),
             )
-            st["n_visited"] = n_vis2
-            st["viol"] = viol2
 
         if seed is not None:
             level_sizes = self._load_seed(bufs, st, seed)
             stats = fetch()
             fv = self._first_viol(stats)
-            gid = fv[1] if fv is not None else (
-                int(stats[2]) if int(stats[2]) < int(BIG) else None
-            )
+            gid = fv[1] if fv is not None else None
             if gid is not None:
                 # violation inside the seeded prefix: the diameter is the
                 # violating state's level, not the full seed depth
@@ -807,19 +785,34 @@ class DeviceChecker:
             n_init = m.n_initial
             if n_init > self.SCAP:
                 raise ValueError("initial-state set exceeds max_states")
-            self._grow_visited(bufs, n_init + self.NC)
-            self._grow_frontier(bufs, n_init + self.NC)
-            self._grow_logs(bufs, n_init + self.NC)
-            for f_off in range(0, n_init, self.NC):
-                dispatch(
-                    self._init_jit(), (jnp.int32(f_off),), f_off, True
+            self._grow_visited(bufs, n_init + self.ACAP)
+            self._grow_store(bufs, n_init + self.APAD)
+            w = 0
+            group_base = 0
+            for f_off in range(0, n_init, self.NCs):
+                out = self._init_jit()(
+                    *bufs["ak"], bufs["arows"], jnp.int32(f_off),
+                    jnp.int32(w * self.NCs),
                 )
+                bufs["ak"], bufs["arows"] = out[:K], out[K]
+                w += 1
+                if w == self.FLUSH or f_off + self.NCs >= n_init:
+                    flush(w * self.NCs, group_base, True)
+                    group_base = f_off + self.NCs
+                    w = 0
             stats = fetch()
             level_sizes = [int(stats[0])]
 
         # ---- BFS levels ----
+        # invariant the dispatch loop maintains: every buffer can absorb
+        # the worst case of all in-flight (unfetched) flushes, i.e.
+        # nv_bound = nv + pending * ACAP stays within VCAP and LCAP.
+        # The current frontier is the contiguous row-store range
+        # [level_base, level_base + nf).
+        nv = int(stats[0])
+        level_base = nv - (level_sizes[-1] if level_sizes else 0)
+        nf = nv - level_base
         while True:
-            nv, nf = int(stats[0]), int(stats[1])
             reason = self._stop_reason(stats, t0)
             if reason is not None and not (
                 reason.get("truncated") and nf == 0
@@ -827,22 +820,41 @@ class DeviceChecker:
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             if nf == 0:
                 return self._result(t0, nv, level_sizes, bufs)
-            # swap frontier windows; reset the next-level accumulator
-            bufs["frontier"], bufs["next"] = bufs["next"], bufs["frontier"]
-            n_frontier = nf
-            level_base = nv - nf
-            st["n_next"] = jnp.int32(0)
+            # the level's expand windows slice [level_base + f_off,
+            # + G); the last partial window may read up to G rows past
+            # the frontier end, so the store must cover it or the
+            # dynamic_slice would clamp and re-expand shifted rows
+            # while silently never expanding the level's tail
+            self._grow_store(bufs, level_base + nf + self.G)
             stop = False
-            pending = 0  # sub-batches dispatched since the last fetch
+            pending = 0  # flushes dispatched since the last fetch
+            w = 0  # accumulator windows filled since the last flush
+            group_f0 = 0  # level offset of the first window in the acc
             try:
-                for f_off in range(0, n_frontier, self.G):
-                    # upper bound on n_visited without a host sync
-                    nv_bound = nv + (pending + 1) * self.NC
+                for f_off in range(0, nf, self.G):
+                    last = f_off + self.G >= nf
+                    out = self._expand_jit()(
+                        *bufs["ak"], bufs["arows"],
+                        self._slice_jit()(
+                            bufs["rows"], jnp.int32(level_base + f_off)
+                        ),
+                        jnp.int32(f_off), jnp.int32(nf), st["dead_gid"],
+                        jnp.int32(level_base), jnp.int32(w * self.NCs),
+                    )
+                    bufs["ak"], bufs["arows"] = out[:K], out[K]
+                    st["dead_gid"] = out[K + 1]
+                    w += 1
+                    if w < self.FLUSH and not last:
+                        continue
+                    # capacity check for THIS flush under the worst case
+                    # of all in-flight (unfetched) flushes: each adds at
+                    # most ACAP states, and the append writes a blind
+                    # APAD-row window past the running n_visited
+                    nv_bound = nv + (pending + 1) * self.ACAP
                     need_sync = (
-                        nv_bound + self.NC > self.VCAP
-                        or nv_bound - level_base + self.NC > self.FCAP
-                        or nv_bound > self.LCAP
-                        or nv_bound > self.SCAP
+                        nv_bound > self.VCAP
+                        or nv_bound - self.ACAP + self.APAD > self.LCAP
+                        or nv_bound - self.ACAP >= self.SCAP
                         or pending >= self.group
                     )
                     if need_sync:
@@ -851,36 +863,27 @@ class DeviceChecker:
                         if self._stop_reason(stats, t0) is not None:
                             stop = True
                             break
-                        # grow only when the NEXT dispatch genuinely
-                        # needs it (growth doubles, so this stays rare)
-                        if nv + self.NC > self.VCAP:
-                            self._grow_visited(bufs, nv + 2 * self.NC)
-                        if nv - level_base + self.NC > self.FCAP:
-                            self._grow_frontier(
-                                bufs, nv - level_base + 2 * self.NC
+                        # grow with enough headroom for a full group of
+                        # in-flight flushes, or every flush would sync
+                        # (growth doubles, so this stays rare)
+                        head = (self.group + 1) * self.ACAP
+                        if nv + self.ACAP > self.VCAP:
+                            self._grow_visited(bufs, nv + head)
+                        if nv + self.APAD > self.LCAP:
+                            self._grow_store(
+                                bufs, nv + head + self.APAD
                             )
-                        if nv > self.LCAP:
-                            self._grow_logs(bufs, nv + 2 * self.NC)
-                    window = self._slice_jit()(
-                        bufs["frontier"], jnp.int32(f_off)
-                    )
-                    dispatch(
-                        self._expand_jit(),
-                        (
-                            window, jnp.int32(f_off),
-                            jnp.int32(n_frontier), st["dead_gid"],
-                            jnp.int32(level_base),
-                        ),
-                        level_base + f_off,
-                        False,
-                    )
+                    flush(w * self.NCs, level_base + group_f0, False)
                     pending += 1
+                    group_f0 = f_off + self.G
+                    w = 0
             except Exception as e:  # noqa: BLE001
                 if "RESOURCE_EXHAUSTED" not in str(e):
                     raise
-                # HBM exhausted: report what was checked so far (truncated).
-                # Only the small stats scalars are read from here on; the
-                # big buffers may hold donated/poisoned storage.
+                # HBM exhausted: report what was checked so far
+                # (truncated).  Only the small stats scalars are read
+                # from here on; the big buffers may hold donated/
+                # poisoned storage.
                 self._log(f"HBM exhausted mid-level: truncating ({e!r:.120})")
                 self._bufs_poisoned = True
                 stop = True
@@ -892,9 +895,9 @@ class DeviceChecker:
                 self._bufs_poisoned = True
                 stop = True  # keep the last successfully fetched stats
             nv = int(stats[0])
-            level_count = max(nv - (level_base + n_frontier), 0)
+            level_count = nv - (level_base + nf)
             if level_count or stop:
-                level_sizes.append(level_count)
+                level_sizes.append(max(level_count, 0))
                 self._emit_metrics(t0, len(level_sizes), level_count, nv, nf)
                 wall = time.time() - t0
                 self._log(
@@ -904,6 +907,8 @@ class DeviceChecker:
             if stop:
                 reason = self._stop_reason(stats, t0) or {"truncated": True}
                 return self._result(t0, nv, level_sizes, bufs, **reason)
+            level_base += nf
+            nf = level_count
 
     def _over_time(self, t0) -> bool:
         return (
@@ -917,8 +922,8 @@ class DeviceChecker:
         fv = self._first_viol(stats)
         if fv is not None:
             return {"viol": fv}
-        if int(stats[2]) < int(BIG):
-            return {"dead_gid": int(stats[2])}
+        if int(stats[1]) < int(BIG):
+            return {"dead_gid": int(stats[1])}
         if int(stats[0]) >= self.SCAP or self._over_time(t0):
             return {"truncated": True}
         return None
@@ -927,7 +932,7 @@ class DeviceChecker:
         """(invariant name, gid) of the lowest-gid violation, or None."""
         best = None
         for i, name in enumerate(self.invariant_names):
-            g = int(stats[3 + i])
+            g = int(stats[2 + i])
             if g < BIG and (best is None or g < best[1]):
                 best = (name, g)
         return best
@@ -1001,6 +1006,7 @@ class DeviceChecker:
             states_per_sec=nv / max(wall, 1e-9),
             level_sizes=level_sizes,
             truncated=truncated,
+            fp_collision_prob=self.keys.collision_prob(nv),
         )
         gid = None
         if viol is not None:

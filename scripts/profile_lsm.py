@@ -1,0 +1,119 @@
+"""Measure the primitive costs that drive the round-3 LSM dedup design
+(engine/device_bfs.py): sort width/operand scaling, contiguous-index
+scatter of packed rows (the candidate append path), clamped-gather of
+rows, and DUS — all at bench shapes on the real chip.
+
+Timing protocol for the tunnel backend: dispatch K iterations (async,
+dispatch is free), then fetch one element as the completion barrier;
+report wall/K.  First call per jit is compile (reported separately).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+W = 20
+N_ACC = 1 << 25          # 33.5M candidate lanes
+N_VIS = 1 << 25          # visited tier
+T = N_ACC + N_VIS
+LIVE_FRAC = 0.03
+
+
+def bench(name, fn, args, k=8):
+    t0 = time.time()
+    out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jnp.ravel(leaf)[0])
+    compile_s = time.time() - t0
+    t0 = time.time()
+    outs = [fn(*args) for _ in range(k)]
+    for o in outs:
+        pass
+    leaf = jax.tree_util.tree_leaves(outs[-1])[0]
+    np.asarray(jnp.ravel(leaf)[0])
+    dt = (time.time() - t0) / k
+    print(f"{name:44s} {dt*1e3:9.1f} ms/iter   (compile {compile_s:.1f}s)",
+          flush=True)
+    return dt
+
+
+def main(which="all"):
+    print(f"device: {jax.devices()[0]}", flush=True)
+    key = jax.random.PRNGKey(0)
+
+    def want(tag):
+        return which in ("all", tag)
+
+    # ---- data ----
+    rows = jax.random.randint(
+        key, (N_ACC, W), 0, 1 << 30, dtype=jnp.int32
+    ).astype(jnp.uint32)
+    n_new = int(N_ACC * LIVE_FRAC)
+    idx_host = np.zeros((N_ACC,), np.int32)
+    idx_host[:n_new] = np.random.permutation(N_ACC)[:n_new]
+    gidx = jnp.asarray(idx_host)  # gather: 3% random, 97% -> row 0
+    # scatter targets: first n_new lanes -> contiguous dests, rest OOB
+    sidx_host = np.full((N_ACC,), N_ACC + 5, np.int64)
+    sidx_host[:n_new] = np.arange(n_new)
+    sidx = jnp.asarray(sidx_host, jnp.int32)
+    store = jnp.zeros((N_ACC + 8, W), jnp.uint32)
+
+    if want("sort"):
+        k1 = jax.random.bits(key, (T,), jnp.uint32)
+        k2 = jax.random.bits(jax.random.PRNGKey(1), (T,), jnp.uint32)
+        pay = jax.random.bits(jax.random.PRNGKey(3), (T,), jnp.uint32)
+        del rows, store
+        s3 = jax.jit(lambda a, b, c: lax.sort((a, b, c), num_keys=3,
+                                              is_stable=False))
+        bench(f"sort 3-operand T={T>>20}M", s3, (k1, k2, pay))
+        s2 = jax.jit(lambda a, b: lax.sort((a, b), num_keys=1,
+                                           is_stable=True))
+        bench(f"sort 2-operand stable T={T>>20}M", s2, (k1, pay))
+        nn = N_ACC
+        s3n = jax.jit(lambda a, b, c: lax.sort((a[:nn], b[:nn], c[:nn]),
+                                               num_keys=3, is_stable=False))
+        bench(f"sort 3-operand T={nn>>20}M", s3n, (k1, k2, pay))
+    if want("sort4"):
+        # round-2 dedup shape for calibration: 42.4M x 4 operands
+        t2 = (1 << 25) + (1 << 23)
+        del rows, store
+        ks = [jax.random.bits(jax.random.PRNGKey(i), (t2,), jnp.uint32)
+              for i in range(4)]
+        s4 = jax.jit(lambda a, b, c, d: lax.sort((a, b, c, d), num_keys=4,
+                                                 is_stable=False))
+        bench(f"sort 4-operand T={t2>>20}M (r2 shape)", s4, tuple(ks))
+    if want("gather"):
+        g = jax.jit(lambda r, i: r[i])
+        bench("gather 33.5M rows[20] (3% random live)", g, (rows, gidx))
+        ridx = jnp.asarray(np.random.permutation(N_ACC).astype(np.int32))
+        bench("gather 33.5M rows[20] (100% random)", g, (rows, ridx))
+    if want("scatter"):
+        sc = jax.jit(
+            lambda st, r, i: st.at[i].set(r, mode="drop",
+                                          unique_indices=True,
+                                          indices_are_sorted=True)
+        )
+        bench("scatter 33.5M rows[20] contig (3% live)", sc,
+              (store, rows, sidx))
+        sidx_all = jnp.arange(N_ACC, dtype=jnp.int32)
+        bench("scatter 33.5M rows[20] contig (all live)", sc,
+              (store, rows, sidx_all))
+        d = jax.jit(lambda st, r: lax.dynamic_update_slice(st, r, (5, 0)))
+        bench("DUS 33.5M rows[20] window", d, (store, rows))
+        st1 = jnp.zeros((N_ACC + 8,), jnp.uint32)
+        sc1 = jax.jit(
+            lambda st, v, i: st.at[i].set(v, mode="drop",
+                                          unique_indices=True,
+                                          indices_are_sorted=True)
+        )
+        bench("scatter 33.5M u32 contig (3% live)", sc1,
+              (st1, jax.random.bits(key, (N_ACC,), jnp.uint32), sidx))
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else "all")

@@ -128,6 +128,36 @@ def test_device_engine_host_seeded_violation_inside_seed():
     assert len(r.trace) == 4
 
 
+def test_device_engine_append_chunking_matches_oracle():
+    """Force the chunked append scan (C > 1) with an append_chunk that
+    does NOT divide ACAP, so the scan's padded tail window is exercised
+    — a clamped payload slice here would silently corrupt the row store
+    (round-3 review regression)."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    m = CompactionModel(c)
+    assert (64 * m.A) % 96  # the pad path is actually taken
+    got = DeviceChecker(
+        m, invariants=(), sub_batch=64, visited_cap=1 << 10,
+        frontier_cap=1 << 10, append_chunk=96, flush_factor=3,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+
+
+def test_device_engine_flush_factor_matches_oracle():
+    """Accumulating several expand windows per flush (the round-3
+    amortization) must not change counts, diameter, or verdicts."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    got = DeviceChecker(
+        CompactionModel(c), invariants=(), sub_batch=128,
+        visited_cap=1 << 10, frontier_cap=1 << 10, flush_factor=4,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+
+
 def test_device_engine_max_states_truncation():
     m = CompactionModel(SMALL_CONFIGS["producer_on"])
     r = DeviceChecker(
