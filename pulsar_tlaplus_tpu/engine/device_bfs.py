@@ -124,8 +124,12 @@ class DeviceChecker:
         self.NCs = self.G * self.A
         self.FLUSH = flush_factor
         self.ACAP = self.NCs * flush_factor
-        if self.ACAP > (1 << 31) - 1:
-            raise ValueError("sub_batch * A * flush_factor exceeds int32")
+        if self.ACAP * self.W >= 1 << 31:
+            # flat accumulator offsets (acc_off * W, idx * W) are int32
+            raise ValueError(
+                "accumulator exceeds int32 flat addressing: "
+                "sub_batch * A * flush_factor * W must stay below 2^31"
+            )
         # append scan chunking: C blind DUS windows of SLc rows cover
         # [n_visited, n_visited + APAD); capacity bounds use APAD
         if append_chunk is not None:
@@ -141,10 +145,24 @@ class DeviceChecker:
         # (allocating max_states-sized stores up front would waste GBs
         # on small runs); ``frontier_cap`` is kept as a sizing hint for
         # compatibility with round-2 callers
-        self.LCAP = min(
-            self._round_cap(max(visited_cap, frontier_cap or 0, self.NCs)),
-            max(max_states, self.NCs),
+        self.LCAP = max(
+            min(
+                self._round_cap(
+                    max(visited_cap, frontier_cap or 0, self.NCs)
+                ),
+                max(max_states, self.NCs) + self.APAD,
+            ),
+            # the very first append writes a blind APAD window at 0, so
+            # no tier below APAD is ever usable (and warmup compiles at
+            # the initial tier)
+            self.APAD,
         )
+        if (max(max_states, self.NCs) + self.APAD) * self.W >= 1 << 31:
+            raise ValueError(
+                "row store exceeds int32 flat addressing: reduce "
+                "max_states (max_states + APAD states x W words must "
+                "stay below 2^31 elements)"
+            )
         self.time_budget_s = time_budget_s
         self.progress = progress
         self.metrics_path = metrics_path
@@ -169,25 +187,34 @@ class DeviceChecker:
     # -------------------------------------------------------- jitted ops
 
     def _slice_jit(self):
-        """Trivial LCAP-dependent slicer: rows[LCAP,W], off -> [G,W]
-        window (a BFS level is a contiguous gid range of the row store).
-        Keeping this separate means row-store growth never recompiles
-        the big expand graph."""
+        """Trivial LCAP-dependent slicer: flat rows[LCAP*W], off ->
+        flat [G*W] window (a BFS level is a contiguous gid range of the
+        row store).  Keeping this separate means row-store growth never
+        recompiles the big expand graph.
+
+        Every multi-GB row buffer in this engine is FLAT 1-D at jit
+        boundaries: a [N, W] array with small W is stored tiled on TPU
+        (minor dim padded toward 128), and ops like gather/DUS can
+        force a full T(8,128) relayout copy of the whole store — 6.4x
+        memory, an instant OOM at bench sizes (measured,
+        scripts/profile_lsm.py).  Flat u32 vectors have no pad; kernels
+        reshape small windows internally."""
         key = ("slice", self.LCAP)
         if key in self._jits:
             return self._jits[key]
         G, W = self.G, self.W
 
         def step(rows, off):
-            return lax.dynamic_slice(rows, (off, 0), (G, W))
+            return lax.dynamic_slice(rows, (off * W,), (G * W,))
 
         fn = jax.jit(step)
         self._jits[key] = fn
         return fn
 
     def _expand_jit(self):
-        """(ak cols, arows, window[G,W], f_off, n_live, dead_gid,
-        gid_base, acc_off) -> (ak', arows', dead_gid').
+        """(ak cols, flat arows[ACAP*W], flat window[G*W], f_off,
+        n_live, dead_gid, gid_base, acc_off) -> (ak', arows',
+        dead_gid').
 
         Expands one G-state window into ``NCs`` candidate lanes and
         appends their key columns + packed rows into the accumulator at
@@ -202,7 +229,9 @@ class DeviceChecker:
         keyspec = self.keys
 
         def chunk(window, f_off, n_live, i):
-            rows = lax.dynamic_slice(window, (i * Fi, 0), (Fi, W))
+            rows = lax.dynamic_slice(
+                window, (i * Fi * W,), (Fi * W,)
+            ).reshape(Fi, W)
             pos = f_off + i * Fi + jnp.arange(Fi, dtype=jnp.int32)
             live = pos < n_live
             states = jax.vmap(layout.unpack)(rows)
@@ -246,7 +275,7 @@ class DeviceChecker:
                 for akc, kc in zip(ak, kcols)
             )
             arows = lax.dynamic_update_slice(
-                arows, packed.reshape(nc, W), (acc_off, 0)
+                arows, packed.reshape(nc * W), (acc_off * W,)
             )
             return (*ak, arows, dead)
 
@@ -280,7 +309,9 @@ class DeviceChecker:
                 lax.dynamic_update_slice(akc, kc, (acc_off,))
                 for akc, kc in zip(ak, kcols)
             )
-            arows = lax.dynamic_update_slice(arows, packed, (acc_off, 0))
+            arows = lax.dynamic_update_slice(
+                arows, packed.reshape(NCs * W), (acc_off * W,)
+            )
             return (*ak, arows)
 
         fn = jax.jit(step, donate_argnums=tuple(range(self.K + 1)))
@@ -328,29 +359,29 @@ class DeviceChecker:
     # ACAP*128*4B — 17 GB at bench shapes; measured, profile_lsm.py)
     SL = 1 << 20
 
-    def _append_jit(self, is_init: bool):
-        """Append the flush's new states: chunked scan that gathers each
+    def _append_core_jit(self, is_init: bool):
+        """Collect the flush's new states: a chunked scan gathers each
         SL-slice of new rows from the accumulator, derives parent gids /
-        action lanes, evaluates the invariants on exactly the new states
-        (deduped — round 2 paid this on every candidate lane), and
-        writes rows + logs with blind full-window DUS chunks.
+        action lanes, and evaluates the invariants on exactly the new
+        states (deduped — round 2 paid this on every candidate lane).
 
-        The window [n_visited, n_visited + ACAP) is written whole; the
-        tail beyond n_new is garbage that the NEXT flush's window
-        overwrites before it can ever be read (reads only touch
-        [0, n_visited)).  The run loop guarantees ``n_visited + ACAP <=
-        LCAP`` before dispatching, so no DUS can clamp."""
-        key = ("append", self.LCAP, is_init)
+        The row gather is chunked because a [n, W] gather result
+        materializes in the TPU tiled layout (minor dim padded to 128 —
+        6.4x memory, measured in profile_lsm.py); each [SL, W] chunk is
+        relayouted into the packed [APAD, W] output as the scan stacks.
+        Kept separate from the store writer so the multi-GB row store
+        itself never enters a gather computation and keeps its packed
+        layout."""
+        key = ("appcore", is_init)
         if key in self._jits:
             return self._jits[key]
-        A = self.A
+        A, W = self.A, self.W
         SL, C = self.SLc, self.C
         layout = self.layout
         inv_fns = [self.model.invariants[n] for n in self.invariant_names]
         n_inv = len(self.invariant_names)
 
-        def step(rows_store, parent_log, lane_log, arows, new_pay, n_new,
-                 n_visited, viol, acc_base):
+        def step(arows, new_pay, n_new, n_visited, viol, acc_base):
             if C * SL > new_pay.shape[0]:
                 # the scan covers C*SL = APAD >= ACAP lanes; pad so the
                 # last chunk's dynamic_slice can never clamp and replay
@@ -362,15 +393,18 @@ class DeviceChecker:
                     ]
                 )
 
-            def chunk(carry, c):
-                rows_store, parent_log, lane_log, viol = carry
+            def chunk(viol, c):
                 lanei = c * SL + jnp.arange(SL, dtype=jnp.int32)
                 live = lanei < n_new
                 pay = lax.dynamic_slice(new_pay, (c * SL,), (SL,))
                 idx = (pay & IDX_MASK).astype(jnp.int32)
                 # dead lanes gather row 0 (cache-resident), so gather
-                # cost tracks n_new, not ACAP
-                src = arows[jnp.where(live, idx, 0)]
+                # cost tracks n_new, not ACAP; rows are W-word slices
+                # of the flat accumulator
+                safe = jnp.where(live, idx, 0)
+                src = jax.vmap(
+                    lambda i: lax.dynamic_slice(arows, (i * W,), (W,))
+                )(safe)
                 if is_init:
                     par = -1 - (acc_base + idx)
                     lane = jnp.zeros((SL,), jnp.int32)
@@ -379,31 +413,59 @@ class DeviceChecker:
                     lane = idx % A
                 par = jnp.where(live, par, 0)
                 lane = jnp.where(live, lane, 0)
-                gids = n_visited + lanei
                 if n_inv:
                     states = jax.vmap(layout.unpack)(src)
+                    gids = n_visited + lanei
                     vnew = []
                     for fn in inv_fns:
                         ok = jax.vmap(fn)(states)
                         bad = live & ~ok
                         vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
                     viol = jnp.minimum(viol, jnp.stack(vnew))
-                off = n_visited + c * SL
-                rows_store = lax.dynamic_update_slice(
-                    rows_store, src, (off, 0)
-                )
-                parent_log = lax.dynamic_update_slice(
-                    parent_log, par, (off,)
-                )
-                lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
-                return (rows_store, parent_log, lane_log, viol), None
+                return viol, (src, par, lane)
 
-            (rows_store, parent_log, lane_log, viol), _ = lax.scan(
-                chunk,
-                (rows_store, parent_log, lane_log, viol),
-                jnp.arange(C, dtype=jnp.int32),
+            viol, (rows, par, lane) = lax.scan(
+                chunk, viol, jnp.arange(C, dtype=jnp.int32)
             )
-            return rows_store, parent_log, lane_log, n_visited + n_new, viol
+            return (
+                rows.reshape(C * SL * W),
+                par.reshape(C * SL),
+                lane.reshape(C * SL),
+                n_visited + n_new,
+                viol,
+            )
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _append_write_jit(self):
+        """Blind DUS writer: append the collected [APAD, W] rows and
+        parent/lane columns at [n_visited, n_visited + APAD).  The tail
+        beyond n_new is garbage that the NEXT flush's window overwrites
+        before it can ever be read (reads only touch [0, n_visited));
+        the run loop guarantees ``n_visited + APAD <= LCAP`` before
+        dispatching, so no DUS can clamp.  DUS-only on purpose: a
+        gather in this computation would force the multi-GB row store
+        into the 128-padded tiled layout."""
+        key = ("appwrite", self.LCAP)
+        if key in self._jits:
+            return self._jits[key]
+
+        W = self.W
+
+        def step(rows_store, parent_log, lane_log, rows, par, lane,
+                 n_visited):
+            rows_store = lax.dynamic_update_slice(
+                rows_store, rows, (n_visited * W,)
+            )
+            parent_log = lax.dynamic_update_slice(
+                parent_log, par, (n_visited,)
+            )
+            lane_log = lax.dynamic_update_slice(
+                lane_log, lane, (n_visited,)
+            )
+            return rows_store, parent_log, lane_log
 
         fn = jax.jit(step, donate_argnums=(0, 1, 2))
         self._jits[key] = fn
@@ -504,9 +566,11 @@ class DeviceChecker:
         if key in self._jits:
             return self._jits[key]
 
+        W = self.W
+
         def write(rows_store, parent_log, lane_log, rows, par, lane, off):
             rows_store = lax.dynamic_update_slice(
-                rows_store, rows, (off, 0)
+                rows_store, rows.reshape(rows.shape[0] * W), (off * W,)
             )
             parent_log = lax.dynamic_update_slice(parent_log, par, (off,))
             lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
@@ -600,10 +664,14 @@ class DeviceChecker:
             self.VCAP *= 2
 
     def _grow_store(self, bufs, need: int):
+        # doubling, capped at the most any run can use (SCAP states
+        # plus one blind append window) so a preset near-SCAP store is
+        # never forced to a wasteful next power of two
+        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
         while self.LCAP < need:
-            pad = self.LCAP
+            pad = min(self.LCAP, max(cap - self.LCAP, need - self.LCAP))
             bufs["rows"] = jnp.concatenate(
-                [bufs["rows"], jnp.zeros((pad, self.W), jnp.uint32)]
+                [bufs["rows"], jnp.zeros((pad * self.W,), jnp.uint32)]
             )
             bufs["parent"] = jnp.concatenate(
                 [bufs["parent"], jnp.zeros((pad,), jnp.int32)]
@@ -611,7 +679,7 @@ class DeviceChecker:
             bufs["lane"] = jnp.concatenate(
                 [bufs["lane"], jnp.zeros((pad,), jnp.int32)]
             )
-            self.LCAP *= 2
+            self.LCAP += pad
 
     # --------------------------------------------------------------- run
 
@@ -638,14 +706,14 @@ class DeviceChecker:
                     jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
                     for _ in range(K)
                 ),
-                z((self.ACAP, self.W), jnp.uint32),
+                z((self.ACAP * self.W,), jnp.uint32),
             )
 
         ak, arows = acc()
         out = self._init_jit()(*ak, arows, jnp.int32(0), jnp.int32(0))
         drain(out)
         ak, arows = out[:K], out[K]
-        rows_buf = z((self.LCAP, self.W), jnp.uint32)
+        rows_buf = z((self.LCAP * self.W,), jnp.uint32)
         window = self._slice_jit()(rows_buf, jnp.int32(0))
         del rows_buf
         out = self._expand_jit()(
@@ -664,15 +732,21 @@ class DeviceChecker:
         new_pay = out[K + 1]
         viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
         for is_init in (True, False):
-            app = self._append_jit(is_init)(
-                z((self.LCAP, self.W), jnp.uint32),
-                z((self.LCAP,), jnp.int32), z((self.LCAP,), jnp.int32),
+            app = self._append_core_jit(is_init)(
                 arows, new_pay, jnp.int32(0), jnp.int32(0), viol0,
                 jnp.int32(0),
             )
             drain(app)
-            del app
-        del ak, arows, new_pay
+        rows_w, par_w, lane_w = app[0], app[1], app[2]
+        del app
+        drain(
+            self._append_write_jit()(
+                z((self.LCAP * self.W,), jnp.uint32),
+                z((self.LCAP,), jnp.int32), z((self.LCAP,), jnp.int32),
+                rows_w, par_w, lane_w, jnp.int32(0),
+            )
+        )
+        del ak, arows, new_pay, rows_w, par_w, lane_w
         drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
         drain(
             self._chain_jit(4)(
@@ -695,7 +769,7 @@ class DeviceChecker:
             )
             drain(
                 write(
-                    z((self.LCAP, self.W), jnp.uint32),
+                    z((self.LCAP * self.W,), jnp.uint32),
                     z((self.LCAP,), jnp.int32),
                     z((self.LCAP,), jnp.int32),
                     z((self.SEED_CHUNK, self.W), jnp.uint32),
@@ -725,8 +799,8 @@ class DeviceChecker:
                 jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
                 for _ in range(K)
             ),
-            "arows": jnp.zeros((self.ACAP, self.W), jnp.uint32),
-            "rows": jnp.zeros((self.LCAP, self.W), jnp.uint32),
+            "arows": jnp.zeros((self.ACAP * self.W,), jnp.uint32),
+            "rows": jnp.zeros((self.LCAP * self.W,), jnp.uint32),
             "parent": jnp.zeros((self.LCAP,), jnp.int32),
             "lane": jnp.zeros((self.LCAP,), jnp.int32),
         }
@@ -757,14 +831,20 @@ class DeviceChecker:
             )
             bufs["vk"] = out[:K]
             n_new, new_pay = out[K], out[K + 1]
-            (
-                bufs["rows"], bufs["parent"], bufs["lane"],
-                st["n_visited"], st["viol"],
-            ) = self._append_jit(is_init)(
-                bufs["rows"], bufs["parent"], bufs["lane"],
+            rows, par, lane, n_vis2, viol2 = self._append_core_jit(
+                is_init
+            )(
                 bufs["arows"], new_pay, n_new, st["n_visited"],
                 st["viol"], jnp.int32(acc_base),
             )
+            bufs["rows"], bufs["parent"], bufs["lane"] = (
+                self._append_write_jit()(
+                    bufs["rows"], bufs["parent"], bufs["lane"],
+                    rows, par, lane, st["n_visited"],
+                )
+            )
+            st["n_visited"] = n_vis2
+            st["viol"] = viol2
 
         if seed is not None:
             level_sizes = self._load_seed(bufs, st, seed)

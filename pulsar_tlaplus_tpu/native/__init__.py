@@ -45,6 +45,45 @@ def build(force: bool = False) -> str:
     return out
 
 
+def build_baseline(force: bool = False) -> str:
+    """Compile the TLC-class native baseline checker
+    (``compaction_bfs.cpp``) into a standalone binary; returns its path.
+    See BASELINE.md: this is the in-image stand-in for 8-worker CPU TLC
+    (no JVM in the image)."""
+    src = os.path.join(_DIR, "compaction_bfs.cpp")
+    out = os.path.join(_DIR, "compaction_bfs")
+    if not force and os.path.exists(out) and os.path.getmtime(
+        out
+    ) >= os.path.getmtime(src):
+        return out
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-pthread", src, "-o", out],
+        check=True, capture_output=True,
+    )
+    return out
+
+
+def run_baseline(
+    m: int, k: int, v: int, c: int, crash: int, producer: bool,
+    retain: bool, budget_s: float, threads: int = 1,
+) -> dict:
+    """Run the native baseline checker; returns its JSON result dict."""
+    import json
+
+    binary = build_baseline()
+    p = subprocess.run(
+        [
+            binary, str(m), str(k), str(v), str(c), str(crash),
+            "1" if producer else "0", "1" if retain else "0",
+            str(budget_s), str(threads),
+        ],
+        capture_output=True, text=True,
+    )
+    if p.returncode not in (0, 1):
+        raise RuntimeError(f"baseline checker failed: {p.stderr[:500]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def load_logstore():
     """Returns the native _logstore module, building it if necessary.
 

@@ -44,8 +44,16 @@ def main(which="all"):
     print(f"device: {jax.devices()[0]}", flush=True)
     key = jax.random.PRNGKey(0)
 
+    valid_tags = ("sort", "sort4", "gather", "scatter")
+    if which not in valid_tags:
+        raise SystemExit(
+            f"unknown section {which!r}: pick one of {valid_tags} "
+            "(sections hold mutually incompatible buffer sets, so "
+            "exactly one runs per process)"
+        )
+
     def want(tag):
-        return which in ("all", tag)
+        return which == tag
 
     # ---- data ----
     rows = jax.random.randint(
@@ -79,7 +87,7 @@ def main(which="all"):
     if want("sort4"):
         # round-2 dedup shape for calibration: 42.4M x 4 operands
         t2 = (1 << 25) + (1 << 23)
-        del rows, store
+        del rows, store  # free HBM for the sort operands
         ks = [jax.random.bits(jax.random.PRNGKey(i), (t2,), jnp.uint32)
               for i in range(4)]
         s4 = jax.jit(lambda a, b, c, d: lax.sort((a, b, c, d), num_keys=4,
@@ -116,4 +124,4 @@ def main(which="all"):
 if __name__ == "__main__":
     import sys
 
-    main(sys.argv[1] if len(sys.argv) > 1 else "all")
+    main(sys.argv[1] if len(sys.argv) > 1 else "sort")  # one tag/process

@@ -1,0 +1,145 @@
+"""Per-stage cost breakdown of the round-3 device engine at bench
+shapes on the real chip (VERDICT r2 weak #2: publish the breakdown).
+
+Times each hot-path jit — expand window, flush (3-sort merge), append
+(chunked gather + DUS) — by dispatching K iterations and fetching one
+element as the completion barrier (the tunnel backend's
+block_until_ready returns at enqueue).
+
+Usage: python scripts/profile_stages.py [sub_batch_log2] [flush_factor]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def barrier(o):
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    np.asarray(jnp.ravel(leaf)[0])
+
+
+def main():
+    g_log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 19
+    flush_factor = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    from pulsar_tlaplus_tpu.engine.device_bfs import BIG, DeviceChecker
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+    from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+    c = Constants(
+        message_sent_limit=64, compaction_times_limit=3, num_keys=8,
+        num_values=2, retain_null_key=True, max_crash_times=3,
+        model_producer=True, model_consumer=False,
+    )
+    model = CompactionModel(c)
+    ck = DeviceChecker(
+        model,
+        sub_batch=1 << g_log2,
+        expand_chunk=1 << 13,
+        visited_cap=1 << 26,
+        frontier_cap=(48_000_000 + (1 << g_log2) * model.A * flush_factor),
+        max_states=48_000_000,
+        flush_factor=flush_factor,
+    )
+    print(
+        f"device {jax.devices()[0]}; G={ck.G} A={ck.A} NCs={ck.NCs} "
+        f"ACAP={ck.ACAP} APAD={ck.APAD} K={ck.K} VCAP={ck.VCAP} "
+        f"LCAP={ck.LCAP} W={ck.W}", flush=True,
+    )
+    t0 = time.time()
+    warm_s = ck.warmup()
+    print(f"warmup compile: {warm_s:.1f}s (wall {time.time()-t0:.1f}s)",
+          flush=True)
+
+    K = ck.K
+    z = jnp.zeros
+    ak = tuple(
+        jnp.full((ck.ACAP,), SENTINEL, jnp.uint32) for _ in range(K)
+    )
+    arows = z((ck.ACAP * ck.W,), jnp.uint32)
+    rows_store = z((ck.LCAP * ck.W,), jnp.uint32)
+    vk = tuple(
+        jnp.full((ck.VCAP,), SENTINEL, jnp.uint32) for _ in range(K)
+    )
+    n_inv = len(ck.invariant_names)
+    viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
+
+    def bench(name, dispatch, iters=6):
+        t0 = time.time()
+        last = None
+        for _ in range(iters):
+            last = dispatch()
+        barrier(last)
+        dt = (time.time() - t0) / iters
+        print(f"{name:34s} {dt*1e3:9.1f} ms", flush=True)
+        return dt
+
+    # seed the frontier with real initial states at row 0..G
+    window = jax.jit(
+        jax.vmap(lambda i: model.layout.pack(model.gen_initial(i)))
+    )(jnp.arange(ck.G, dtype=jnp.int32) % model.n_initial).reshape(
+        ck.G * ck.W
+    )
+    barrier(window)
+
+    def do_expand():
+        nonlocal ak, arows
+        out = ck._expand_jit()(
+            *ak, arows, window, jnp.int32(0), jnp.int32(ck.G), BIG,
+            jnp.int32(0), jnp.int32(0),
+        )
+        ak, arows = out[:K], out[K]
+        return out[K + 1]
+
+    t_expand = bench("expand window (G states)", do_expand)
+
+    def do_flush():
+        nonlocal vk
+        out = ck._flush_jit()(*vk, *ak, jnp.int32(ck.ACAP))
+        vk = out[:K]
+        return out[K]
+
+    t_flush = bench("flush (3-sort merge)", do_flush)
+
+    out = ck._flush_jit()(*vk, *ak, jnp.int32(ck.ACAP))
+    vk, n_new, new_pay = out[:K], out[K], out[K + 1]
+    barrier(n_new)
+    print(f"  (n_new in flush probe: {int(np.asarray(n_new))})", flush=True)
+
+    par_log = z((ck.LCAP,), jnp.int32)
+    lane_log = z((ck.LCAP,), jnp.int32)
+
+    def do_append():
+        nonlocal rows_store, par_log, lane_log
+        rows, par, lane, nv2, _v = ck._append_core_jit(False)(
+            arows, new_pay, n_new, jnp.int32(0), viol0, jnp.int32(0),
+        )
+        rows_store, par_log, lane_log = ck._append_write_jit()(
+            rows_store, par_log, lane_log, rows, par, lane, jnp.int32(0),
+        )
+        return nv2
+
+    t_append = bench("append (gather+invariants+DUS)", do_append)
+
+    per_flush = t_expand * flush_factor + t_flush + t_append
+    print(
+        f"total per flush: {per_flush*1e3:.1f} ms for {ck.ACAP} candidate "
+        f"lanes ({ck.G * flush_factor} states expanded)", flush=True,
+    )
+    print(
+        f"  -> ceiling at 100%/30%/10% new-rate: "
+        f"{ck.ACAP/per_flush/1e6:.2f} / {0.3*ck.ACAP/per_flush/1e6:.2f} / "
+        f"{0.1*ck.ACAP/per_flush/1e6:.2f} M st/s", flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
