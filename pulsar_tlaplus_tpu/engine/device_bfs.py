@@ -354,10 +354,11 @@ class DeviceChecker:
         return fn
 
     # gather/DUS chunk for the append scan: bounds the transient tiled
-    # buffer a [n, W] gather result materializes on TPU (the minor dim
-    # pads to 128 in the tiled layout, so a full-ACAP gather would be
-    # ACAP*128*4B — 17 GB at bench shapes; measured, profile_lsm.py)
-    SL = 1 << 20
+    # buffers one chunk materializes (gather result + unpacked states +
+    # invariant intermediates, all proportional to SL lanes; a
+    # full-ACAP gather would be 17 GB at bench shapes — measured,
+    # profile_lsm.py)
+    SL = 1 << 18
 
     def _append_core_jit(self, is_init: bool):
         """Collect the flush's new states: a chunked scan gathers each
@@ -737,6 +738,8 @@ class DeviceChecker:
                 jnp.int32(0),
             )
             drain(app)
+            if is_init:
+                del app  # both app tuples alive at once would be ~3 GB
         rows_w, par_w, lane_w = app[0], app[1], app[2]
         del app
         drain(

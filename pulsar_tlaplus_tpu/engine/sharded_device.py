@@ -1,0 +1,876 @@
+"""Device-resident mesh-sharded BFS checker (VERDICT r2 missing #2).
+
+The round-2 ``ShardedChecker`` proved the sharding *semantics* (owner =
+``key % n_shards``, identical counts on any mesh) but staged every chunk
+through host numpy — hopeless behind the 130 ms / 20 MB/s tunnel and no
+basis for the v5e-8 target.  This engine ports the round-3 single-chip
+design (``engine/device_bfs.py``) into ``shard_map``:
+
+- every shard owns HBM-resident visited key columns, a packed row store
+  (its states, in local-gid order), parent/lane trace logs, and a
+  candidate accumulator — the exact single-chip layout, one per shard;
+- each BFS round, every shard expands a window of its own frontier,
+  buckets the candidate lanes by key owner (one-hot running-rank, no
+  host), and one ``all_to_all`` routes keys + packed rows + parent gid +
+  action lane to the owning shards (ICI traffic on a real slice);
+- received lanes accumulate locally; the flush (the shared
+  ``ops.dedup.merge_new_keys`` sort-merge) and append run per shard
+  inside the same jitted program — sort sizes are ``1/n_shards`` of the
+  single-chip engine's, which is where the multi-chip speedup lives;
+- the host fetches ONE per-shard stats matrix per group of flushes and
+  only orchestrates: rounds, levels, growth, verdicts.
+
+Global state ids encode ``(shard, local gid)`` as
+``shard << SB | local`` so parent chains cross shards; counterexamples
+replay through the model exactly like the single-chip engine.
+
+Determinism/exactness: counts, levels, and verdict sets are identical
+for any shard count (tested on the virtual CPU mesh for n in {1,2,4,8}
+and vs the Python oracle).  Routing capacity is ``slack *
+lanes/n_shards`` per destination; an overflow cannot corrupt the search
+— it sets a sticky flag that fail-stops the run with a clear error
+(raise ``route_slack``), never a silent drop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
+from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
+from pulsar_tlaplus_tpu.ref import pyeval
+
+BIG = jnp.int32(2**31 - 1)
+TAG_BIT = jnp.uint32(1 << 31)
+IDX_MASK = jnp.uint32((1 << 31) - 1)
+
+AXIS = "shard"
+
+
+def _owner(kcols, n: int):
+    """Owning shard of a key: a murmur-style mix of the columns, mod n.
+    Exact (non-hashed) keys are raw state words whose low bits can be
+    heavily skewed; mixing keeps per-destination counts near lanes/n so
+    the dense routing capacity holds."""
+    h = kcols[0]
+    for c in kcols[1:]:
+        h = (h ^ c) * jnp.uint32(0xCC9E2D51)
+        h = (h << jnp.uint32(13)) | (h >> jnp.uint32(19))
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    return (h % jnp.uint32(n)).astype(jnp.int32)
+
+
+def _route_accumulate(
+    kcols, packed, par, lane, ak, arows, apar, alane, acc_off,
+    N: int, CAPO: int, W: int,
+):
+    """Bucket candidate lanes by key owner (one-hot running rank — no
+    sort, no host), route them with one ``all_to_all``, and append the
+    received lanes into the local accumulator at ``acc_off``.
+
+    Invalid lanes carry all-SENTINEL keys; they (and rank-overflow
+    lanes) target the out-of-bounds index and are genuinely dropped by
+    the scatters.  Returns ``(ak, arows, apar, alane, over)`` where
+    ``over`` flags a per-destination capacity overflow (fail-stop
+    upstream, never silent loss)."""
+    K = len(kcols)
+    L = kcols[0].shape[0]
+    valid = kcols[0] != SENTINEL
+    for c in kcols[1:]:
+        valid = valid | (c != SENTINEL)
+    owner = _owner(kcols, N)
+    onehot = (
+        owner[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]
+    ) & valid[:, None]
+    ranks = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    rank = jnp.take_along_axis(ranks, owner[:, None], axis=1)[:, 0] - 1
+    over = jnp.any(ranks[-1] > CAPO)
+    # dropped lanes target N*CAPO — out of bounds for every send buffer,
+    # so mode="drop" discards them and the in-bounds indices really are
+    # unique (the unique_indices promise holds)
+    q = jnp.where(valid & (rank < CAPO), owner * CAPO + rank, N * CAPO)
+
+    def send1(col, fill):
+        z = jnp.full((N * CAPO,), fill, col.dtype)
+        return z.at[q].set(col, mode="drop", unique_indices=True)
+
+    s_cols = [send1(c, SENTINEL) for c in kcols]
+    s_par = send1(par, jnp.int32(0))
+    s_lane = send1(lane, jnp.int32(0))
+    # rows: word-granularity flat scatter (keeps everything 1-D; a
+    # [L, W] scatter would force tiled layouts)
+    qw = q[:, None] * W + jnp.arange(W, dtype=jnp.int32)[None, :]
+    s_rows = (
+        jnp.zeros((N * CAPO * W,), jnp.uint32)
+        .at[qw.reshape(L * W)]
+        .set(packed.reshape(L * W), mode="drop", unique_indices=True)
+    )
+    stack = jnp.stack(
+        [c.astype(jnp.uint32) for c in s_cols]
+        + [
+            lax.bitcast_convert_type(s_par, jnp.uint32),
+            lax.bitcast_convert_type(s_lane, jnp.uint32),
+        ]
+    ).reshape(K + 2, N, CAPO)
+    r_stack = lax.all_to_all(
+        stack, AXIS, split_axis=1, concat_axis=1, tiled=False
+    ).reshape(K + 2, N * CAPO)
+    r_rows = lax.all_to_all(
+        s_rows.reshape(N, CAPO * W), AXIS, split_axis=0,
+        concat_axis=0, tiled=False,
+    ).reshape(N * CAPO * W)
+    ak = tuple(
+        lax.dynamic_update_slice(a, r_stack[i], (acc_off,))
+        for i, a in enumerate(ak)
+    )
+    apar = lax.dynamic_update_slice(
+        apar, lax.bitcast_convert_type(r_stack[K], jnp.int32), (acc_off,)
+    )
+    alane = lax.dynamic_update_slice(
+        alane,
+        lax.bitcast_convert_type(r_stack[K + 1], jnp.int32),
+        (acc_off,),
+    )
+    arows = lax.dynamic_update_slice(arows, r_rows, (acc_off * W,))
+    return ak, arows, apar, alane, over
+
+
+class ShardedDeviceChecker:
+    """Level-synchronous BFS over a 1-D device mesh, fully device-resident.
+
+    Capacities are PER SHARD; hash ownership keeps shards balanced to
+    within sampling noise, so per-shard capacity ~ total / n_shards.
+    """
+
+    SB = 26  # local-gid bits in the global id (shard << SB | local)
+
+    def __init__(
+        self,
+        model,
+        n_devices: Optional[int] = None,
+        invariants: Optional[Tuple[str, ...]] = None,
+        check_deadlock: bool = True,
+        sub_batch: int = 1024,
+        expand_chunk: Optional[int] = None,
+        visited_cap: int = 1 << 14,
+        max_states: int = 1 << 26,
+        time_budget_s: Optional[float] = None,
+        progress: bool = False,
+        metrics_path: Optional[str] = None,
+        group: int = 4,
+        flush_factor: int = 1,
+        fp_bits: Optional[int] = None,
+        route_slack: float = 1.5,
+        append_chunk: Optional[int] = None,
+    ):
+        self.model = model
+        self.layout = model.layout
+        if invariants is None:
+            invariants = getattr(
+                model, "default_invariants", pyeval.DEFAULT_INVARIANTS
+            )
+        self.invariant_names = tuple(invariants)
+        model_invs = getattr(model, "invariants", None)
+        if (
+            model_invs is not None
+            and "__EvalError__" in model_invs
+            and "__EvalError__" not in self.invariant_names
+        ):
+            self.invariant_names += ("__EvalError__",)
+        self.check_deadlock = check_deadlock
+        devs = jax.devices()
+        self.N = n_devices or len(devs)
+        if self.N > len(devs):
+            raise ValueError(f"need {self.N} devices, have {len(devs)}")
+        if self.N > 1 << (30 - self.SB):
+            raise ValueError("too many shards for the global-gid encoding")
+        self.mesh = Mesh(np.array(devs[: self.N]), (AXIS,))
+        self.A = model.A
+        self.W = self.layout.W
+        self.G = sub_batch  # states expanded per shard per round
+        self.Fi = expand_chunk or min(sub_batch, 8192)
+        if self.G % self.Fi:
+            raise ValueError("sub_batch must be a multiple of expand_chunk")
+        self.NCs = self.G * self.A  # candidate lanes sent per shard/round
+        # per-destination route capacity; hash ownership concentrates
+        # counts at NCs/N, so slack=1.5 is far beyond sampling noise —
+        # and an overflow fail-stops, never corrupts
+        self.CAPO = int(-(-self.NCs * route_slack // self.N))
+        self.RCV = self.N * self.CAPO  # lanes received per shard/round
+        self.FLUSH = flush_factor
+        self.ACAP = self.RCV * flush_factor  # accumulator lanes per shard
+        self.keys = KeySpec(self.layout.total_bits, self.W, fp_bits)
+        self.K = self.keys.ncols
+        self.SL = append_chunk or (1 << 18)
+        self.SLc = min(self.SL, self.ACAP)
+        self.C = -(-self.ACAP // self.SLc)
+        self.APAD = self.C * self.SLc
+        self.VCAP = self._round_cap(visited_cap)
+        self.SCAP = max_states  # global
+        self.LCAP = max(
+            min(
+                self._round_cap(max(visited_cap, self.NCs)),
+                max(max_states // self.N, self.NCs) + self.APAD,
+            ),
+            self.APAD,
+        )
+        if self.LCAP > 1 << self.SB:
+            raise ValueError("per-shard store exceeds local-gid bits")
+        if self.ACAP * self.W >= 1 << 31 or self.LCAP * self.W >= 1 << 31:
+            raise ValueError("flat buffers exceed int32 addressing")
+        self.time_budget_s = time_budget_s
+        self.progress = progress
+        self.metrics_path = metrics_path
+        self.group = group
+        self._jits: Dict[tuple, object] = {}
+
+    # -------------------------------------------------------------- util
+
+    def _round_cap(self, c: int) -> int:
+        n = 1 << 10
+        while n < c:
+            n <<= 1
+        return n
+
+    def _log(self, msg: str):
+        if self.progress:
+            import sys
+
+            print(f"  {msg}", file=sys.stderr, flush=True)
+
+    def _shard(self, spec=P(AXIS)):
+        return NamedSharding(self.mesh, spec)
+
+    def _smap(self, body, in_specs, out_specs, donate=()):
+        fn = jax.shard_map(
+            body, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=donate)
+
+    # ------------------------------------------------------ device code
+
+    def _round_jit(self):
+        """One BFS round: expand a per-shard frontier window, bucket by
+        key owner, all_to_all, accumulate received lanes.
+
+        (ak cols, arows, apar, alane, rows, lb, nf, dead, ovf, r,
+        acc_off) -> (ak', arows', apar', alane', dead', ovf')
+        """
+        key = ("round", self.LCAP)
+        if key in self._jits:
+            return self._jits[key]
+        m, layout, keyspec = self.model, self.layout, self.keys
+        K, W, A, N = self.K, self.W, self.A, self.N
+        G, Fi, NCs, CAPO = self.G, self.Fi, self.NCs, self.CAPO
+
+        def body(ak, arows, apar, alane, rows, lb, nf, dead, ovf, r,
+                 acc_off):
+            # local blocks arrive with a leading length-1 shard axis
+            ak = tuple(a[0] for a in ak)
+            arows, apar, alane = arows[0], apar[0], alane[0]
+            rows, lb, nf, dead, ovf = (
+                rows[0], lb[0], nf[0], dead[0], ovf[0]
+            )
+            shard = lax.axis_index(AXIS).astype(jnp.int32)
+            f_off = r * G
+            window = lax.dynamic_slice(
+                rows, ((lb + f_off) * W,), (G * W,)
+            )
+
+            def chunk(i):
+                rws = lax.dynamic_slice(
+                    window, (i * Fi * W,), (Fi * W,)
+                ).reshape(Fi, W)
+                pos = f_off + i * Fi + jnp.arange(Fi, dtype=jnp.int32)
+                live = pos < nf
+                states = jax.vmap(layout.unpack)(rws)
+                succ, valid = jax.vmap(m.successors)(states)
+                valid = valid & live[:, None]
+                packed = jax.vmap(jax.vmap(layout.pack))(succ)
+                fa = Fi * A
+                packedf = packed.reshape(fa, W)
+                kcols = keyspec.make(packedf)
+                vflat = valid.reshape(fa)
+                kcols = tuple(
+                    jnp.where(vflat, c, SENTINEL) for c in kcols
+                )
+                par = (shard << self.SB) | (
+                    lb + pos[:, None] + jnp.zeros((1, A), jnp.int32)
+                )
+                lane = jnp.zeros((Fi, 1), jnp.int32) + jnp.arange(
+                    A, dtype=jnp.int32
+                )
+                if self.check_deadlock:
+                    stut = jax.vmap(m.stutter_enabled)(states)
+                    dead_rows = live & ~jnp.any(valid, axis=1) & ~stut
+                    didx = jnp.min(
+                        jnp.where(
+                            dead_rows,
+                            (shard << self.SB) | (lb + pos), BIG,
+                        )
+                    )
+                else:
+                    didx = BIG
+                return (
+                    kcols, packedf, par.reshape(fa), lane.reshape(fa),
+                    didx,
+                )
+
+            def scan_body(dead, i):
+                kcols, p, par, lane, didx = chunk(i)
+                return jnp.minimum(dead, didx), (kcols, p, par, lane)
+
+            dead, (kcols, packed, par, lane) = lax.scan(
+                scan_body, dead, jnp.arange(G // Fi, dtype=jnp.int32)
+            )
+            kcols = tuple(c.reshape(NCs) for c in kcols)
+            packed = packed.reshape(NCs, W)
+            par = par.reshape(NCs)
+            lane = lane.reshape(NCs)
+
+            ak, arows, apar, alane, over = _route_accumulate(
+                kcols, packed, par, lane, ak, arows, apar, alane,
+                acc_off, N, CAPO, W,
+            )
+            ovf = ovf | over
+            return (
+                tuple(a[None] for a in ak), arows[None], apar[None],
+                alane[None], dead[None], ovf[None],
+            )
+
+        sh = P(AXIS)
+        in_specs = (
+            (sh,) * self.K, sh, sh, sh, sh, sh, sh, sh, sh, P(), P(),
+        )
+        out_specs = ((sh,) * self.K, sh, sh, sh, sh, sh)
+        fn = self._smap(
+            body, in_specs, out_specs, donate=(0, 1, 2, 3)
+        )
+        self._jits[key] = fn
+        return fn
+
+    def _init_round_jit(self):
+        """Initial-state round: shard s generates init indices
+        [base + s*NCs, base + (s+1)*NCs) and routes them by ownership —
+        the same contract as an expand round (par = -1 - init_idx)."""
+        key = ("initround",)
+        if key in self._jits:
+            return self._jits[key]
+        m, layout, keyspec = self.model, self.layout, self.keys
+        K, W, N = self.K, self.W, self.N
+        NCs, CAPO = self.NCs, self.CAPO
+        n_init = min(m.n_initial, (1 << 31) - 1)
+
+        def body(ak, arows, apar, alane, ovf, base, acc_off):
+            ak = tuple(a[0] for a in ak)
+            arows, apar, alane, ovf = arows[0], apar[0], alane[0], ovf[0]
+            shard = lax.axis_index(AXIS).astype(jnp.int32)
+            idx = base + shard * NCs + jnp.arange(NCs, dtype=jnp.int32)
+            states = jax.vmap(m.gen_initial)(
+                jnp.where(idx < n_init, idx, 0)
+            )
+            packed = jax.vmap(layout.pack)(states)
+            valid = idx < n_init
+            kcols = keyspec.make(packed)
+            kcols = tuple(jnp.where(valid, c, SENTINEL) for c in kcols)
+            par = -1 - idx
+            lane = jnp.zeros((NCs,), jnp.int32)
+
+            ak, arows, apar, alane, over = _route_accumulate(
+                kcols, packed, par, lane, ak, arows, apar, alane,
+                acc_off, N, CAPO, W,
+            )
+            ovf = ovf | over
+            return (
+                tuple(a[None] for a in ak), arows[None], apar[None],
+                alane[None], ovf[None],
+            )
+
+        sh = P(AXIS)
+        in_specs = ((sh,) * self.K, sh, sh, sh, sh, P(), P())
+        out_specs = ((sh,) * self.K, sh, sh, sh, sh)
+        fn = self._smap(
+            body, in_specs, out_specs, donate=(0, 1, 2, 3)
+        )
+        self._jits[key] = fn
+        return fn
+
+    def _flush_jit(self):
+        """Per-shard sort-merge of the accumulator into the visited set
+        (the shared dedup core), then payload compaction."""
+        key = ("flush", self.VCAP)
+        if key in self._jits:
+            return self._jits[key]
+        K, ACAP = self.K, self.ACAP
+
+        def body(vk, ak, n_acc):
+            vk = tuple(v[0] for v in vk)
+            ak = tuple(a[0] for a in ak)
+            lanei = jnp.arange(ACAP, dtype=jnp.int32)
+            amask = lanei < n_acc
+            ccols = tuple(jnp.where(amask, a, SENTINEL) for a in ak)
+            cpay = lanei.astype(jnp.uint32) | TAG_BIT
+            vk2, n_new, sp, new_flag = dedup.merge_new_keys(
+                vk, ccols, cpay
+            )
+            nn = (~new_flag).astype(jnp.uint32)
+            _, new_pay = lax.sort((nn, sp), num_keys=1, is_stable=True)
+            return (
+                tuple(v[None] for v in vk2), n_new[None],
+                new_pay[:ACAP][None],
+            )
+
+        sh = P(AXIS)
+        fn = self._smap(
+            body, ((sh,) * self.K, (sh,) * self.K, P()),
+            ((sh,) * self.K, sh, sh),
+            donate=(0,),
+        )
+        self._jits[key] = fn
+        return fn
+
+    def _append_jit(self):
+        """Per-shard append of the flush's new states: chunked gathers
+        from the accumulator (rows + routed parent/lane), invariant
+        evaluation on exactly the new states, blind DUS windows into the
+        local row store and trace logs."""
+        key = ("append", self.LCAP)
+        if key in self._jits:
+            return self._jits[key]
+        W = self.W
+        SL, C = self.SLc, self.C
+        layout = self.layout
+        inv_fns = [self.model.invariants[n] for n in self.invariant_names]
+        n_inv = len(self.invariant_names)
+
+        def body(rows, parent_log, lane_log, arows, apar, alane, new_pay,
+                 n_new, n_visited, viol):
+            rows, parent_log, lane_log = rows[0], parent_log[0], lane_log[0]
+            arows, apar, alane = arows[0], apar[0], alane[0]
+            new_pay, n_new = new_pay[0], n_new[0]
+            n_visited, viol = n_visited[0], viol[0]
+            shard = lax.axis_index(AXIS).astype(jnp.int32)
+            if C * SL > new_pay.shape[0]:
+                new_pay = jnp.concatenate(
+                    [
+                        new_pay,
+                        jnp.zeros((C * SL - new_pay.shape[0],), jnp.uint32),
+                    ]
+                )
+
+            def chunk(carry, c):
+                rows, parent_log, lane_log, viol = carry
+                lanei = c * SL + jnp.arange(SL, dtype=jnp.int32)
+                live = lanei < n_new
+                pay = lax.dynamic_slice(new_pay, (c * SL,), (SL,))
+                idx = (pay & IDX_MASK).astype(jnp.int32)
+                safe = jnp.where(live, idx, 0)
+                src = jax.vmap(
+                    lambda i: lax.dynamic_slice(arows, (i * W,), (W,))
+                )(safe)
+                par = jnp.where(live, apar[safe], 0)
+                lane = jnp.where(live, alane[safe], 0)
+                if n_inv:
+                    states = jax.vmap(layout.unpack)(src)
+                    gids = (shard << self.SB) | (n_visited + lanei)
+                    vnew = []
+                    for fn in inv_fns:
+                        ok = jax.vmap(fn)(states)
+                        bad = live & ~ok
+                        vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
+                    viol = jnp.minimum(viol, jnp.stack(vnew))
+                off = n_visited + c * SL
+                rows = lax.dynamic_update_slice(
+                    rows, src.reshape(SL * W), (off * W,)
+                )
+                parent_log = lax.dynamic_update_slice(
+                    parent_log, par, (off,)
+                )
+                lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
+                return (rows, parent_log, lane_log, viol), None
+
+            (rows, parent_log, lane_log, viol), _ = lax.scan(
+                chunk, (rows, parent_log, lane_log, viol),
+                jnp.arange(C, dtype=jnp.int32),
+            )
+            return (
+                rows[None], parent_log[None], lane_log[None],
+                (n_visited + n_new)[None], viol[None],
+            )
+
+        sh = P(AXIS)
+        fn = self._smap(
+            body, (sh,) * 10, (sh,) * 5, donate=(0, 1, 2),
+        )
+        self._jits[key] = fn
+        return fn
+
+    def _stats_jit(self):
+        key = ("stats",)
+        if key in self._jits:
+            return self._jits[key]
+
+        def step(n_visited, dead, viol, ovf):
+            return jnp.concatenate(
+                [
+                    n_visited[:, None], dead[:, None], viol,
+                    ovf[:, None].astype(jnp.int32),
+                ],
+                axis=1,
+            )
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    # ------------------------------------------------------------ growth
+
+    def _grow_visited(self, bufs, need: int):
+        while self.VCAP < need:
+            pad = self.VCAP
+            bufs["vk"] = tuple(
+                jnp.concatenate(
+                    [
+                        col,
+                        jnp.full((self.N, pad), SENTINEL, jnp.uint32,
+                                 device=self._shard()),
+                    ],
+                    axis=1,
+                )
+                for col in bufs["vk"]
+            )
+            self.VCAP *= 2
+
+    def _grow_store(self, bufs, need: int):
+        cap = max(
+            self.SCAP // self.N + self.APAD, self.NCs + self.APAD
+        )
+        while self.LCAP < need:
+            pad = min(self.LCAP, max(cap - self.LCAP, need - self.LCAP))
+            bufs["rows"] = jnp.concatenate(
+                [
+                    bufs["rows"],
+                    jnp.zeros((self.N, pad * self.W), jnp.uint32,
+                              device=self._shard()),
+                ],
+                axis=1,
+            )
+            for k in ("parent", "lane"):
+                bufs[k] = jnp.concatenate(
+                    [
+                        bufs[k],
+                        jnp.zeros((self.N, pad), jnp.int32,
+                                  device=self._shard()),
+                    ],
+                    axis=1,
+                )
+            self.LCAP += pad
+            if self.LCAP > 1 << self.SB:
+                raise ValueError(
+                    "per-shard store exceeds local-gid bits"
+                )
+
+    # --------------------------------------------------------------- run
+
+    def run(self, resume: bool = False) -> CheckerResult:
+        if resume:
+            raise ValueError(
+                "the device-resident sharded engine does not support "
+                "checkpoint/resume yet; use -sharded-engine host"
+            )
+        t0 = time.time()
+        m = self.model
+        N, K, n_inv = self.N, self.K, len(self.invariant_names)
+        sh = self._shard()
+        bufs = {
+            "vk": tuple(
+                jnp.full((N, self.VCAP), SENTINEL, jnp.uint32, device=sh)
+                for _ in range(K)
+            ),
+            "ak": tuple(
+                jnp.full((N, self.ACAP), SENTINEL, jnp.uint32, device=sh)
+                for _ in range(K)
+            ),
+            "arows": jnp.zeros((N, self.ACAP * self.W), jnp.uint32,
+                               device=sh),
+            "apar": jnp.zeros((N, self.ACAP), jnp.int32, device=sh),
+            "alane": jnp.zeros((N, self.ACAP), jnp.int32, device=sh),
+            "rows": jnp.zeros((N, self.LCAP * self.W), jnp.uint32,
+                              device=sh),
+            "parent": jnp.zeros((N, self.LCAP), jnp.int32, device=sh),
+            "lane": jnp.zeros((N, self.LCAP), jnp.int32, device=sh),
+        }
+        st = {
+            "n_visited": jnp.zeros((N,), jnp.int32, device=sh),
+            "dead": jnp.full((N,), int(BIG), jnp.int32, device=sh),
+            "viol": jnp.full((N, n_inv), int(BIG), jnp.int32, device=sh),
+            "ovf": jnp.zeros((N,), jnp.bool_, device=sh),
+        }
+        stats_fn = self._stats_jit()
+        self._host_wait_s = 0.0
+
+        def fetch():
+            tf = time.time()
+            out = np.asarray(
+                stats_fn(
+                    st["n_visited"], st["dead"], st["viol"], st["ovf"]
+                )
+            )
+            self._host_wait_s += time.time() - tf
+            if out[:, 2 + n_inv].any():
+                raise RuntimeError(
+                    "candidate routing overflowed its per-destination "
+                    "capacity; re-run with a larger route_slack"
+                )
+            return out
+
+        def flush(n_acc: int):
+            out = self._flush_jit()(
+                bufs["vk"], bufs["ak"], jnp.int32(n_acc)
+            )
+            bufs["vk"] = tuple(out[0])
+            n_new, new_pay = out[1], out[2]
+            (
+                bufs["rows"], bufs["parent"], bufs["lane"],
+                st["n_visited"], st["viol"],
+            ) = self._append_jit()(
+                bufs["rows"], bufs["parent"], bufs["lane"],
+                bufs["arows"], bufs["apar"], bufs["alane"],
+                new_pay, n_new, st["n_visited"], st["viol"],
+            )
+
+        # ---- level 1: initial states, routed to owners ----
+        n_init = m.n_initial
+        if n_init > self.SCAP:
+            raise ValueError("initial-state set exceeds max_states")
+        per_round = N * self.NCs
+        w = 0
+        for base in range(0, n_init, per_round):
+            out = self._init_round_jit()(
+                bufs["ak"], bufs["arows"], bufs["apar"], bufs["alane"],
+                st["ovf"], jnp.int32(base), jnp.int32(w * self.RCV),
+            )
+            bufs["ak"] = tuple(out[0])
+            bufs["arows"], bufs["apar"], bufs["alane"], st["ovf"] = out[1:]
+            w += 1
+            if w == self.FLUSH or base + per_round >= n_init:
+                # capacity for the worst case of this flush
+                need = int(np.asarray(st["n_visited"]).max())
+                self._grow_visited(bufs, need + self.ACAP)
+                self._grow_store(bufs, need + self.APAD)
+                flush(w * self.RCV)
+                w = 0
+        stats = fetch()
+        nv = stats[:, 0].copy()
+        level_sizes = [int(nv.sum())]
+        lb = np.zeros((N,), np.int64)
+        nf = nv.copy()
+
+        # ---- BFS levels ----
+        while True:
+            reason = self._stop_reason(stats, t0)
+            if reason is not None and not (
+                reason.get("truncated") and nf.sum() == 0
+            ):
+                return self._result(t0, stats, level_sizes, bufs, **reason)
+            if nf.sum() == 0:
+                return self._result(t0, stats, level_sizes, bufs)
+            self._grow_store(bufs, int((lb + nf).max()) + self.G)
+            lb_dev = jax.device_put(
+                np.asarray(lb, np.int32), self._shard()
+            )
+            nf_dev = jax.device_put(
+                np.asarray(nf, np.int32), self._shard()
+            )
+            rounds = int(-(-nf.max() // self.G))
+            stop = False
+            pending = 0
+            w = 0
+            nv_bound = nv.max()
+            for r in range(rounds):
+                last = r + 1 >= rounds
+                out = self._round_jit()(
+                    bufs["ak"], bufs["arows"], bufs["apar"],
+                    bufs["alane"], bufs["rows"], lb_dev, nf_dev,
+                    st["dead"], st["ovf"], jnp.int32(r),
+                    jnp.int32(w * self.RCV),
+                )
+                bufs["ak"] = tuple(out[0])
+                (
+                    bufs["arows"], bufs["apar"], bufs["alane"],
+                    st["dead"], st["ovf"],
+                ) = out[1:]
+                w += 1
+                if w < self.FLUSH and not last:
+                    continue
+                nv_bound = nv_bound + self.ACAP
+                need_sync = (
+                    nv_bound + self.ACAP > self.VCAP
+                    or nv_bound + self.APAD > self.LCAP
+                    or (nv_bound - self.ACAP) * N >= self.SCAP
+                    or pending >= self.group
+                )
+                if need_sync:
+                    stats = fetch()
+                    nv = stats[:, 0].copy()
+                    nv_bound = nv.max()
+                    pending = 0
+                    if self._stop_reason(stats, t0) is not None:
+                        stop = True
+                        break
+                    head = (self.group + 1) * self.ACAP
+                    if nv.max() + self.ACAP > self.VCAP:
+                        self._grow_visited(bufs, int(nv.max()) + head)
+                    if nv.max() + self.APAD > self.LCAP:
+                        self._grow_store(
+                            bufs, int(nv.max()) + head + self.APAD
+                        )
+                flush(w * self.RCV)
+                pending += 1
+                w = 0
+            stats = fetch()
+            nv2 = stats[:, 0].copy()
+            level_count = (nv2 - (lb + nf)).sum()
+            if level_count or stop:
+                level_sizes.append(int(max(level_count, 0)))
+                wall = time.time() - t0
+                total = int(nv2.sum())
+                self._emit_metrics(t0, len(level_sizes), level_count,
+                                   total)
+                self._log(
+                    f"level {len(level_sizes)}: +{level_count} "
+                    f"(total {total}, {total/max(wall,1e-9):.0f} st/s)"
+                )
+            if stop:
+                reason = self._stop_reason(stats, t0) or {
+                    "truncated": True
+                }
+                return self._result(
+                    t0, stats, level_sizes, bufs, **reason
+                )
+            lb = lb + nf
+            nf = nv2 - lb
+            nv = nv2
+            if nf.sum() == 0 and level_count == 0:
+                return self._result(t0, stats, level_sizes, bufs)
+
+    # ----------------------------------------------------------- control
+
+    def _over_time(self, t0) -> bool:
+        return (
+            self.time_budget_s is not None
+            and time.time() - t0 > self.time_budget_s
+        )
+
+    def _stop_reason(self, stats, t0) -> Optional[dict]:
+        fv = self._first_viol(stats)
+        if fv is not None:
+            return {"viol": fv}
+        dead = stats[:, 1]
+        if (dead < int(BIG)).any():
+            return {"dead_gid": int(dead.min())}
+        if stats[:, 0].sum() >= self.SCAP or self._over_time(t0):
+            return {"truncated": True}
+        return None
+
+    def _first_viol(self, stats) -> Optional[Tuple[str, int]]:
+        best = None
+        for i, name in enumerate(self.invariant_names):
+            g = int(stats[:, 2 + i].min())
+            if g < int(BIG) and (best is None or g < best[1]):
+                best = (name, g)
+        return best
+
+    def _emit_metrics(self, t0, level, level_count, total):
+        if not self.metrics_path:
+            return
+        import json
+
+        wall = time.time() - t0
+        with open(self.metrics_path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "level": level,
+                        "new_states": int(level_count),
+                        "distinct_states": total,
+                        "wall_s": round(wall, 3),
+                        "host_wait_s": round(self._host_wait_s, 3),
+                        "states_per_sec": round(
+                            total / max(wall, 1e-9), 1
+                        ),
+                        "n_shards": self.N,
+                    }
+                )
+                + "\n"
+            )
+
+    # ------------------------------------------------------------- trace
+
+    def _trace(self, bufs, gid: int, max_depth: int):
+        """Walk the cross-shard parent chain on the host (per-hop fetch
+        of two scalars; traces are rare and shallow), then replay lanes
+        through the model."""
+        par_log = bufs["parent"]
+        lane_log = bufs["lane"]
+        chain = []
+        g = gid
+        for _ in range(max_depth):
+            if g < 0:
+                break
+            s, idx = g >> self.SB, g & ((1 << self.SB) - 1)
+            lane = int(np.asarray(lane_log[s, idx]))
+            chain.append((g, lane))
+            g = int(np.asarray(par_log[s, idx]))
+        assert g < 0, "root of parent chain must be an initial state"
+        init_idx = -1 - g
+        chain.reverse()
+        return self.model.replay_trace(
+            init_idx, [lane for _gid, lane in chain[1:]]
+        )
+
+    # ------------------------------------------------------------ result
+
+    def _result(
+        self, t0, stats, level_sizes, bufs,
+        viol: Optional[Tuple[str, int]] = None,
+        dead_gid: Optional[int] = None,
+        truncated: bool = False,
+    ) -> CheckerResult:
+        self.last_bufs = bufs
+        wall = time.time() - t0
+        nv = int(stats[:, 0].sum())
+        res = CheckerResult(
+            distinct_states=nv,
+            diameter=len(level_sizes),
+            deadlock=dead_gid is not None,
+            wall_s=wall,
+            states_per_sec=nv / max(wall, 1e-9),
+            level_sizes=level_sizes,
+            truncated=truncated,
+            fp_collision_prob=self.keys.collision_prob(nv),
+        )
+        gid = None
+        if viol is not None:
+            res.violation = viol[0]
+            gid = viol[1]
+        elif dead_gid is not None:
+            res.violation = "Deadlock"
+            gid = dead_gid
+        if gid is not None:
+            res.trace, res.trace_actions = self._trace(
+                bufs, gid, len(level_sizes) + 2
+            )
+        return res

@@ -66,16 +66,23 @@ def build_baseline(force: bool = False) -> str:
 def run_baseline(
     m: int, k: int, v: int, c: int, crash: int, producer: bool,
     retain: bool, budget_s: float, threads: int = 1,
+    table_log2: int | None = None,
 ) -> dict:
-    """Run the native baseline checker; returns its JSON result dict."""
+    """Run the native baseline checker; returns its JSON result dict.
+
+    ``table_log2`` sizes the fingerprint table (slots = 2^n); small
+    differential-test configs should pass ~22 so each run does not
+    zero-fill the 1 GB bench-sized default table."""
     import json
 
     binary = build_baseline()
+    if table_log2 is None:
+        table_log2 = 27 if producer else 22
     p = subprocess.run(
         [
             binary, str(m), str(k), str(v), str(c), str(crash),
             "1" if producer else "0", "1" if retain else "0",
-            str(budget_s), str(threads),
+            str(budget_s), str(threads), str(table_log2),
         ],
         capture_output=True, text=True,
     )

@@ -270,7 +270,8 @@ int main(int argc, char **argv) {
   if (argc < 9) {
     std::fprintf(
         stderr,
-        "usage: %s M K V C crash producer retain budget_s [threads]\n",
+        "usage: %s M K V C crash producer retain budget_s [threads] "
+        "[table_log2]\n",
         argv[0]);
     return 2;
   }
@@ -295,7 +296,9 @@ int main(int argc, char **argv) {
         .count();
   };
 
-  FpSet seen(cfg.producer ? 27 : 22); // 134M / 4M slots
+  int table_log2 = argc > 10 ? std::atoi(argv[10])
+                            : (cfg.producer ? 27 : 22);
+  FpSet seen((size_t)table_log2);
   std::atomic<bool> violated{false};
   std::vector<State> frontier, next;
   State z;
@@ -322,7 +325,7 @@ int main(int argc, char **argv) {
     if (!type_safe(s) || !horizon_correct(s)) violated = true;
 
   size_t levels = 1;
-  bool truncated = false;
+  std::atomic<bool> truncated{false};
 
   while (!frontier.empty() && !truncated && !violated.load()) {
     next.clear();
@@ -375,7 +378,8 @@ int main(int argc, char **argv) {
               "\"states_per_sec\": %.1f, \"truncated\": %s, "
               "\"violated\": %s, \"threads\": %d}\n",
               n, levels, dt, n / (dt > 0 ? dt : 1e-9),
-              truncated ? "true" : "false", violated ? "true" : "false",
+              truncated.load() ? "true" : "false",
+              violated ? "true" : "false",
               nthreads);
   return violated ? 1 : 0;
 }

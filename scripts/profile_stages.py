@@ -44,9 +44,9 @@ def main():
         model,
         sub_batch=1 << g_log2,
         expand_chunk=1 << 13,
-        visited_cap=1 << 26,
-        frontier_cap=(48_000_000 + (1 << g_log2) * model.A * flush_factor),
-        max_states=48_000_000,
+        visited_cap=1 << 25,
+        frontier_cap=(24_000_000 + (1 << g_log2) * model.A * flush_factor),
+        max_states=24_000_000,
         flush_factor=flush_factor,
     )
     print(

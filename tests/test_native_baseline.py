@@ -14,7 +14,7 @@ def _run(c, budget_s=300.0):
     return native.run_baseline(
         c.message_sent_limit, c.num_keys, c.num_values,
         c.compaction_times_limit, c.max_crash_times, c.model_producer,
-        c.retain_null_key, budget_s,
+        c.retain_null_key, budget_s, table_log2=22,
     )
 
 
@@ -28,7 +28,9 @@ def test_native_baseline_shipped_cfg_published_count():
 def test_native_baseline_full_cfg_published_count():
     """Producer modeled, RetainNullKey=FALSE: the 253,361-state /
     diameter-23 oracle (compaction.tla:23)."""
-    r = native.run_baseline(3, 2, 2, 3, 1, True, False, 300.0)
+    r = native.run_baseline(
+        3, 2, 2, 3, 1, True, False, 300.0, table_log2=22
+    )
     assert not r["truncated"] and not r["violated"]
     assert r["distinct_states"] == 253361
     assert r["levels"] == 23

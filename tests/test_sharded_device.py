@@ -1,0 +1,84 @@
+"""Differential tests for the device-resident sharded engine
+(engine/sharded_device.py): counts, diameters, and verdicts must be
+identical to the Python oracle for EVERY shard count (SURVEY.md §4e —
+multi-node determinism on a virtual CPU mesh), and counterexamples must
+replay through the model exactly like the single-chip engine's."""
+
+import pytest
+
+from pulsar_tlaplus_tpu.engine.sharded_device import ShardedDeviceChecker
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+from tests.helpers import SMALL_CONFIGS, assert_valid_counterexample
+
+
+@pytest.mark.parametrize("n", [1, 2, 8])
+def test_sharded_device_counts_identical_across_meshes(n):
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    got = ShardedDeviceChecker(
+        CompactionModel(c), n_devices=n, invariants=(), sub_batch=128,
+        visited_cap=1 << 10,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.violation is None and not got.deadlock
+
+
+def test_sharded_device_shipped_cfg_published_count():
+    """45,198 distinct states / diameter 20 (compaction.tla:23) on an
+    8-shard mesh — the init fanout (729 states) is routed too."""
+    got = ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=8, sub_batch=512,
+        visited_cap=1 << 13,
+    ).run()
+    assert got.distinct_states == 45198
+    assert got.diameter == 20
+    assert got.violation is None and not got.deadlock
+
+
+def test_sharded_device_leak_counterexample_replays():
+    got = ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=4,
+        invariants=("CompactedLedgerLeak",), sub_batch=512,
+        visited_cap=1 << 13,
+    ).run()
+    assert got.violation == "CompactedLedgerLeak"
+    assert got.diameter == 12
+    assert len(got.trace) == 12
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, got.trace, got.trace_actions, "CompactedLedgerLeak"
+    )
+
+
+def test_sharded_device_growth_matches_oracle():
+    """Tiny initial capacities force visited + store growth mid-run on
+    every shard; counts must stay exact."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    got = ShardedDeviceChecker(
+        CompactionModel(c), n_devices=4, invariants=(), sub_batch=64,
+        visited_cap=1 << 6, group=2,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+
+
+def test_sharded_device_flush_factor_matches_oracle():
+    c = SMALL_CONFIGS["two_crashes"]
+    want = pe.check(c, invariants=())
+    got = ShardedDeviceChecker(
+        CompactionModel(c), n_devices=2, invariants=(), sub_batch=128,
+        visited_cap=1 << 10, flush_factor=3,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+
+
+def test_sharded_device_truncation():
+    m = CompactionModel(SMALL_CONFIGS["producer_on"])
+    r = ShardedDeviceChecker(
+        m, n_devices=4, invariants=(), sub_batch=64,
+        visited_cap=1 << 10, max_states=64,
+    ).run()
+    assert r.truncated
