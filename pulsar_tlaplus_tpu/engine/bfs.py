@@ -221,9 +221,12 @@ class Checker:
         return vk
 
     def _config_sig(self) -> str:
+        model_sig = getattr(self.model, "config_sig", None) or repr(
+            getattr(self.model, "c", None)
+        )
         return repr(
             (
-                self.model.c,
+                model_sig,
                 self.invariant_names,
                 self.layout.total_bits,
                 self.dedup_mode,

@@ -139,8 +139,14 @@ class DeviceChecker:
         self.APAD = self.C * self.SLc
         self.keys = KeySpec(self.layout.total_bits, self.W, fp_bits)
         self.K = self.keys.ncols
-        self.VCAP = self._round_cap(visited_cap)
         self.SCAP = max_states
+        # the visited set can never hold more than max_states + one
+        # accumulator of candidates, so cap the power-of-two tier there
+        # (a 40M-state run would otherwise pay a 67M-wide flush sort)
+        self.VCAP = min(
+            self._round_cap(visited_cap),
+            max(max_states + self.ACAP, self.ACAP * 2),
+        )
         # the row store + trace logs grow geometrically toward SCAP
         # (allocating max_states-sized stores up front would waste GBs
         # on small runs); ``frontier_cap`` is kept as a sizing hint for
@@ -292,19 +298,33 @@ class DeviceChecker:
         if key in self._jits:
             return self._jits[key]
         m, layout = self.model, self.layout
-        NCs, W = self.NCs, self.W
+        NCs, W, Fi = self.NCs, self.W, self.Fi
         keyspec = self.keys
         n_init = min(m.n_initial, (1 << 31) - 1)
 
-        def step(*args):
-            ak = args[: self.K]
-            arows, f_off, acc_off = args[self.K:]
-            idx = f_off + jnp.arange(NCs, dtype=jnp.int32)
+        def chunk(f_off, i):
+            # Fi lanes per scan step: an unchunked vmap over all NCs
+            # lanes materializes the full unpacked state structs —
+            # gigabytes at bench widths (this OOMed the first bench run)
+            idx = f_off + i * Fi + jnp.arange(Fi, dtype=jnp.int32)
             states = jax.vmap(m.gen_initial)(idx)
             packed = jax.vmap(layout.pack)(states)
             valid = idx < n_init
             kcols = keyspec.make(packed)
-            kcols = tuple(jnp.where(valid, c, SENTINEL) for c in kcols)
+            return (
+                tuple(jnp.where(valid, c, SENTINEL) for c in kcols),
+                packed,
+            )
+
+        def step(*args):
+            ak = args[: self.K]
+            arows, f_off, acc_off = args[self.K:]
+            _, (kcols, packed) = lax.scan(
+                lambda c, i: (c, chunk(f_off, i)),
+                0,
+                jnp.arange(NCs // Fi, dtype=jnp.int32),
+            )
+            kcols = tuple(c.reshape(NCs) for c in kcols)
             ak = tuple(
                 lax.dynamic_update_slice(akc, kc, (acc_off,))
                 for akc, kc in zip(ak, kcols)
@@ -358,7 +378,7 @@ class DeviceChecker:
     # invariant intermediates, all proportional to SL lanes; a
     # full-ACAP gather would be 17 GB at bench shapes — measured,
     # profile_lsm.py)
-    SL = 1 << 18
+    SL = 1 << 14
 
     def _append_core_jit(self, is_init: bool):
         """Collect the flush's new states: a chunked scan gathers each
@@ -654,15 +674,16 @@ class DeviceChecker:
     # ------------------------------------------------------------ growth
 
     def _grow_visited(self, bufs, need: int):
+        cap = max(self.SCAP + self.ACAP, self.ACAP * 2)
         while self.VCAP < need:
-            pad = self.VCAP
+            pad = min(self.VCAP, max(cap - self.VCAP, need - self.VCAP))
             bufs["vk"] = tuple(
                 jnp.concatenate(
                     [col, jnp.full((pad,), SENTINEL, jnp.uint32)]
                 )
                 for col in bufs["vk"]
             )
-            self.VCAP *= 2
+            self.VCAP += pad
 
     def _grow_store(self, bufs, need: int):
         # doubling, capped at the most any run can use (SCAP states

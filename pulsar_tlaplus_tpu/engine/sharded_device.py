@@ -212,7 +212,7 @@ class ShardedDeviceChecker:
         self.ACAP = self.RCV * flush_factor  # accumulator lanes per shard
         self.keys = KeySpec(self.layout.total_bits, self.W, fp_bits)
         self.K = self.keys.ncols
-        self.SL = append_chunk or (1 << 18)
+        self.SL = append_chunk or (1 << 14)
         self.SLc = min(self.SL, self.ACAP)
         self.C = -(-self.ACAP // self.SLc)
         self.APAD = self.C * self.SLc
@@ -373,18 +373,37 @@ class ShardedDeviceChecker:
         NCs, CAPO = self.NCs, self.CAPO
         n_init = min(m.n_initial, (1 << 31) - 1)
 
-        def body(ak, arows, apar, alane, ovf, base, acc_off):
-            ak = tuple(a[0] for a in ak)
-            arows, apar, alane, ovf = arows[0], apar[0], alane[0], ovf[0]
-            shard = lax.axis_index(AXIS).astype(jnp.int32)
-            idx = base + shard * NCs + jnp.arange(NCs, dtype=jnp.int32)
+        Fi = self.Fi
+
+        def chunk(start, i):
+            # Fi lanes per scan step (an unchunked vmap over all NCs
+            # lanes materializes the full unpacked state structs —
+            # gigabytes at bench widths)
+            idx = start + i * Fi + jnp.arange(Fi, dtype=jnp.int32)
             states = jax.vmap(m.gen_initial)(
                 jnp.where(idx < n_init, idx, 0)
             )
             packed = jax.vmap(layout.pack)(states)
             valid = idx < n_init
             kcols = keyspec.make(packed)
-            kcols = tuple(jnp.where(valid, c, SENTINEL) for c in kcols)
+            return (
+                tuple(jnp.where(valid, c, SENTINEL) for c in kcols),
+                packed,
+            )
+
+        def body(ak, arows, apar, alane, ovf, base, acc_off):
+            ak = tuple(a[0] for a in ak)
+            arows, apar, alane, ovf = arows[0], apar[0], alane[0], ovf[0]
+            shard = lax.axis_index(AXIS).astype(jnp.int32)
+            start = base + shard * NCs
+            idx = start + jnp.arange(NCs, dtype=jnp.int32)
+            _, (kcols, packed) = lax.scan(
+                lambda c, i: (c, chunk(start, i)),
+                0,
+                jnp.arange(NCs // Fi, dtype=jnp.int32),
+            )
+            kcols = tuple(c.reshape(NCs) for c in kcols)
+            packed = packed.reshape(NCs, W)
             par = -1 - idx
             lane = jnp.zeros((NCs,), jnp.int32)
 

@@ -1888,8 +1888,18 @@ def infer_var_descs(spec: Spec, max_iters: int = 64) -> Dict[str, object]:
     """Abstract fixpoint: Init seeds the descriptors, Next widens them
     (with guard narrowing) until stable."""
     descs: Dict[str, object] = {}
-    # Init: enumerate host-side through the interpreter (exact) and join
-    for s in spec.initial_states():
+    # Init seeds: when Init factors into per-variable draws, a
+    # representative sample covering every per-slot candidate value
+    # joins to the same descriptors as the full cross product — which
+    # can be astronomically large (compaction.tla:191-194 at M=64);
+    # otherwise enumerate host-side through the interpreter (exact)
+    vfactors = _factor_init_values(spec)
+    init_sample = (
+        _init_value_sample(vfactors)
+        if vfactors is not None
+        else spec.initial_states()
+    )
+    for s in init_sample:
         for v, val in zip(spec.vars, s):
             descs[v] = join(descs.get(v), desc_of_value(val))
     for _ in range(max_iters):
@@ -1916,6 +1926,455 @@ def infer_var_descs(spec: Spec, max_iters: int = 64) -> Dict[str, object]:
 # ----------------------------------------------------- engine adapter
 
 
+class _FactoredInit:
+    """Cross-product initial-state set generated by a mixed-radix
+    counting kernel instead of host enumeration (VERDICT r2 #5 /
+    SURVEY.md §3.2: the reference's ``ModelProducer=FALSE`` Init draws
+    ``(|KeySet|*|ValueSet|)^MessageSentLimit`` sequences — host
+    enumeration explodes where counting is free).
+
+    ``factors`` is one entry per state variable, in ``spec.vars``
+    order:
+
+    - ``("const", encoded)`` — a single value (``var = expr``);
+    - ``("choice", tables, n)`` — ``var \\in S``: pytree with a leading
+      ``n`` axis of encoded candidate values;
+    - ``("funseq", tables, radices)`` — a filtered function/sequence
+      space factored per position: pytree with leading ``[P, R]`` axes
+      (position, per-position candidate), plus per-position radices.
+
+    ``gen_initial(idx)`` peels mixed-radix digits off ``idx`` (least
+    significant factor first) and gathers each variable's encoded
+    value — O(state size), fully traced, no tables of the product.
+    """
+
+    def __init__(self, factors, n_initial: int):
+        self.factors = factors
+        self.n = n_initial
+
+    def gen(self, idx):
+        out = {}
+        rem = idx
+        for var, kind, payload in self.factors:
+            if kind == "const":
+                out[var] = jax.tree_util.tree_map(
+                    jnp.asarray, payload
+                )
+                continue
+            if kind == "choice":
+                tables, n = payload
+                digit = rem % n
+                rem = rem // n
+                out[var] = jax.tree_util.tree_map(
+                    lambda t: jnp.asarray(t)[digit], tables
+                )
+                continue
+            mk, tables, radices = payload
+            digits = []
+            for r in radices:
+                digits.append(rem % r)
+                rem = rem // r
+            dvec = jnp.stack(digits)
+            pvec = jnp.arange(len(radices), dtype=jnp.int32)
+            out[var] = mk(
+                jax.tree_util.tree_map(
+                    lambda t: jnp.asarray(t)[pvec, dvec], tables
+                )
+            )
+        out[ERR_VAR] = jnp.bool_(False)
+        return out
+
+
+def _factor_init_values(spec: Spec):
+    """Recognize a purely conjunctive Init over per-variable draws;
+    returns per-variable VALUE factors (one per ``spec.vars`` entry) or
+    ``None`` when Init falls outside the factored form (callers then
+    host-enumerate, exact as before).
+
+    Handled conjunct shapes (after resolving constant-guarded
+    disjunction branches, e.g. the reference's ModelProducer split):
+
+    - ``var = closed_expr`` -> ``("const", value)``
+    - ``var \\in closed_set_expr`` -> ``("choice", values)``
+    - ``var \\in {f \\in [D -> R] : \\A i \\in D : P(i, f[i])}`` ->
+      ``("funseq", per_position_values, dom_len)`` — the filter factors
+      per position because ``P`` sees ``f`` only at ``i``, so position
+      ``d``'s candidates are ``{r \\in R : P(d, r)}``
+    """
+    from pulsar_tlaplus_tpu.frontend import interp as I
+    from pulsar_tlaplus_tpu.frontend import tla_ast as A
+
+    if hasattr(spec, "_init_factor_cache"):
+        return spec._init_factor_cache
+    spec._init_factor_cache = None
+    # eval_expr resolves spec-level definitions through this module
+    # slot; spec.initial_states() used to set it as a side effect, and
+    # later compile passes (UNCHANGED resolution) still read it
+    I._enum._defs = spec.defs
+    d = spec.defs.get("Init")
+    if d is None or d.params:
+        return None
+    genv = spec.genv
+    varset = set(spec.vars)
+
+    def closed(node) -> bool:
+        return not I._refs_any(node, varset, spec.defs)
+
+    def flatten(node, out):
+        """Conjunction flattener; constant-guarded disjunctions resolve
+        to their single live branch."""
+        if isinstance(node, A.Junction) and node.op == "/\\":
+            for it in node.items:
+                if not flatten(it, out):
+                    return False
+            return True
+        if isinstance(node, A.BinOp) and node.op == "/\\":
+            return flatten(node.lhs, out) and flatten(node.rhs, out)
+        if (
+            isinstance(node, A.Junction) and node.op == "\\/"
+        ) or (isinstance(node, A.BinOp) and node.op == "\\/"):
+            items = (
+                node.items
+                if isinstance(node, A.Junction)
+                else (node.lhs, node.rhs)
+            )
+            live = []
+            for br in items:
+                sub: list = []
+                guards_true = True
+                if not flatten(br, sub):
+                    return False
+                kept = []
+                for c in sub:
+                    if c[0] == "guard":
+                        if not c[1]:
+                            guards_true = False
+                    else:
+                        kept.append(c)
+                if guards_true:
+                    live.append(kept)
+            if len(live) != 1:
+                return False  # nondeterministic across branches
+            out.extend(live[0])
+            return True
+        if closed(node):
+            try:
+                val = I.eval_expr(node, genv)
+            except I.EvalError:
+                return False
+            if not isinstance(val, bool):
+                return False
+            out.append(("guard", val))
+            return True
+        # var = expr / var \in expr
+        if isinstance(node, A.BinOp) and node.op in ("=", "\\in"):
+            lhs = node.lhs
+            if (
+                isinstance(lhs, A.Name)
+                and lhs.name in varset
+                and closed(node.rhs)
+            ):
+                out.append((node.op, lhs.name, node.rhs))
+                return True
+        return False
+
+    conj: list = []
+    if not flatten(d.body, conj):
+        return None
+    assigned = {}
+    for c in conj:
+        if c[0] == "guard":
+            if not c[1]:
+                return None  # Init is unsatisfiable; fall back
+            continue
+        op, var, rhs = c
+        if var in assigned:
+            return None
+        assigned[var] = (op, rhs)
+    if set(assigned) != varset:
+        return None
+
+    factors = []
+    for var in spec.vars:
+        op, rhs = assigned[var]
+        if op == "=":
+            try:
+                factors.append(("const", I.eval_expr(rhs, genv)))
+            except I.EvalError:
+                return None
+            continue
+        fact = _factor_membership_values(spec, rhs)
+        if fact is None:
+            return None
+        factors.append(fact)
+    spec._init_factor_cache = factors
+    return factors
+
+
+def _init_value_sample(factors):
+    """Representative initial states covering every per-slot candidate
+    value — sufficient to seed descriptor inference (descriptors are
+    per-field value joins, so covering each slot's candidates is as
+    informative as the full cross product)."""
+    width = 1
+    for f in factors:
+        if f[0] == "choice":
+            width = max(width, len(f[1]))
+        elif f[0] == "funseq":
+            width = max(width, max(len(p) for p in f[1]))
+    states = []
+    for j in range(width):
+        row = []
+        for f in factors:
+            if f[0] == "const":
+                row.append(f[1])
+            elif f[0] == "choice":
+                row.append(f[1][min(j, len(f[1]) - 1)])
+            else:
+                from pulsar_tlaplus_tpu.frontend import interp as I
+
+                per_pos, dom_vals = f[1], f[2]
+                picks = [
+                    p[min(j, len(p) - 1)] for p in per_pos
+                ]
+                if list(dom_vals) == list(range(1, len(dom_vals) + 1)):
+                    row.append(tuple(picks))
+                else:
+                    row.append(
+                        I.make_fn(dict(zip(dom_vals, picks)))
+                    )
+        states.append(tuple(row))
+    return states
+
+
+def _fvar_only_indexed(node, fvar: str, ivar: str) -> bool:
+    """True iff every occurrence of ``fvar`` in ``node`` is exactly the
+    application ``fvar[ivar]`` (and ``fvar``/``ivar`` are never
+    shadowed-rebound, conservatively rejected)."""
+    from pulsar_tlaplus_tpu.frontend import tla_ast as A
+    import dataclasses as _dc
+
+    ok = True
+
+    def walk(n):
+        nonlocal ok
+        if not ok or not isinstance(n, A.Node):
+            return
+        if isinstance(n, A.Index):
+            if (
+                isinstance(n.fn, A.Name)
+                and n.fn.name == fvar
+            ):
+                if not (
+                    len(n.args) == 1
+                    and isinstance(n.args[0], A.Name)
+                    and n.args[0].name == ivar
+                ):
+                    ok = False
+                return
+        if isinstance(n, A.Name) and n.name == fvar:
+            ok = False
+            return
+        # conservatively reject rebinding of either name
+        for binder_attr in ("var",):
+            v = getattr(n, binder_attr, None)
+            if v in (fvar, ivar):
+                ok = False
+                return
+        if isinstance(n, (A.Quant,)):
+            for v, _dom in n.bindings:
+                if v in (fvar, ivar):
+                    ok = False
+                    return
+        for f in _dc.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(v, A.Node):
+                walk(v)
+            elif isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, A.Node):
+                        walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, A.Node):
+                                walk(y)
+
+    walk(node)
+    return ok
+
+
+def _factor_membership_values(spec: Spec, rhs):
+    """Factor one membership conjunct at the VALUE level; returns
+    ``("choice", values)`` or ``("funseq", per_position_values,
+    dom_len)`` or None."""
+    from pulsar_tlaplus_tpu.frontend import interp as I
+    from pulsar_tlaplus_tpu.frontend import tla_ast as A
+
+    genv = spec.genv
+    # the pointwise-filtered function space
+    if (
+        isinstance(rhs, A.SetFilter)
+        and isinstance(rhs.domain, A.FnSpace)
+        and isinstance(rhs.pred, A.Quant)
+        and rhs.pred.kind == "A"
+        and len(rhs.pred.bindings) == 1
+    ):
+        fvar = rhs.var
+        ivar, idom = rhs.pred.bindings[0]
+        if not _fvar_only_indexed(rhs.pred.body, fvar, ivar):
+            # the one-entry-function probe below is only faithful when
+            # the predicate sees f exclusively as f[ivar]; DOMAIN f,
+            # Len(f), f[other] etc. would silently mis-evaluate
+            return None
+        try:
+            dom_vals = sorted(
+                I._enum_set(I.eval_expr(rhs.domain.domain, genv)),
+                key=I._sort_key,
+            )
+            rng_vals = sorted(
+                I._enum_set(I.eval_expr(rhs.domain.codomain, genv)),
+                key=I._sort_key,
+            )
+            quant_dom = frozenset(
+                I._enum_set(I.eval_expr(idom, genv))
+            )
+        except I.EvalError:
+            return None
+        per_pos = []
+        try:
+            for dv in dom_vals:
+                if dv not in quant_dom:
+                    per_pos.append(list(rng_vals))
+                    continue
+                keep = []
+                for rv in rng_vals:
+                    # P sees f only at f[ivar]: a one-entry function
+                    # faithfully evaluates it, and any other access
+                    # raises (-> fall back to host enumeration)
+                    env = genv.child(
+                        {
+                            fvar: I.make_fn({dv: rv}),
+                            ivar: dv,
+                        }
+                    )
+                    v = I.eval_expr(rhs.pred.body, env)
+                    if not isinstance(v, bool):
+                        return None
+                    if v:
+                        keep.append(rv)
+                per_pos.append(keep)
+        except I.EvalError:
+            return None
+        if any(not p for p in per_pos):
+            return None  # empty position => empty set; fall back
+        return ("funseq", per_pos, tuple(dom_vals))
+    # a flat closed enumerable set
+    try:
+        vals = sorted(
+            I._enum_set(I.eval_expr(rhs, genv)), key=I._sort_key
+        )
+    except I.EvalError:
+        return None
+    if not vals or len(vals) > 1 << 20:
+        return None
+    return ("choice", vals)
+
+
+def _try_factor_init(spec: Spec, var_descs) -> Optional[_FactoredInit]:
+    """Encode the value factors of :func:`_factor_init_values` into the
+    counting-kernel generator; ``None`` when Init does not factor or a
+    value falls outside its descriptors (callers host-enumerate)."""
+    vfactors = _factor_init_values(spec)
+    if vfactors is None:
+        return None
+    factors = []
+    n_total = 1
+    try:
+        for var, f in zip(spec.vars, vfactors):
+            desc = var_descs[var]
+            if f[0] == "const":
+                factors.append((var, "const", encode_value(desc, f[1])))
+                continue
+            if f[0] == "choice":
+                vals = f[1]
+                enc = [encode_value(desc, v) for v in vals]
+                tables = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *enc
+                )
+                factors.append((var, "choice", (tables, len(vals))))
+                n_total *= len(vals)
+                continue
+            enc2 = _encode_funseq(desc, f[1], f[2])
+            if enc2 is None:
+                return None
+            payload, count = enc2
+            factors.append((var, "funseq", payload))
+            n_total *= count
+    except CodegenError:
+        return None
+    return _FactoredInit(factors, n_total)
+
+
+def _encode_funseq(desc, per_pos, dom_vals):
+    """Encode per-position candidate tables for a factored function or
+    sequence draw: pytree with leading [position, candidate] axes (pad
+    repeats the last candidate; unreachable digits).  Returns
+    ``((mk, stacked, radices), count)`` or None."""
+    dom_len = len(dom_vals)
+    radices = [len(p) for p in per_pos]
+    rmax = max(radices)
+    if isinstance(desc, DSeq):
+        if (
+            desc.cap < dom_len
+            or desc.elem is None
+            or list(dom_vals) != list(range(1, dom_len + 1))
+        ):
+            return None
+        elem_desc = desc.elem
+        mk = lambda full: (np.int32(dom_len), full)  # noqa: E731
+    elif isinstance(desc, DFun) and not desc.partial:
+        if tuple(desc.keys) != tuple(dom_vals):
+            return None
+        elem_desc = desc.val
+        mk = lambda full: ((), full)  # noqa: E731
+    else:
+        return None
+    rows = [
+        [
+            encode_value(elem_desc, p[min(j, len(p) - 1)])
+            for j in range(rmax)
+        ]
+        for p in per_pos
+    ]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs),
+        *[
+            jax.tree_util.tree_map(lambda *ys: np.stack(ys), *row)
+            for row in rows
+        ],
+    )
+    # sequences shorter than cap: pad positions to desc.cap with zero
+    # elements so the stacked tree matches the codec layout
+    if isinstance(desc, DSeq) and desc.cap > dom_len:
+        zero = encode_value_zero(elem_desc)
+        pad = jax.tree_util.tree_map(
+            lambda z: np.broadcast_to(
+                np.asarray(z)[None, None],
+                (desc.cap - dom_len, rmax) + np.asarray(z).shape,
+            ),
+            zero,
+        )
+        stacked = jax.tree_util.tree_map(
+            lambda t, pz: np.concatenate([t, pz], axis=0),
+            stacked, pad,
+        )
+        radices = radices + [1] * (desc.cap - dom_len)
+    n = 1
+    for r in radices:
+        n *= r
+    return ((mk, stacked, radices), n)
+
+
 class CompiledSpec:
     """Engine-facing compiled model for an arbitrary spec (the device
     BFS protocol: layout/pack/unpack, gen_initial, successors, fused
@@ -1931,22 +2390,30 @@ class CompiledSpec:
         self.codec_descs = dict(self.var_descs)
         self.codec_descs[ERR_VAR] = DBool()
         self.layout = DescCodec(self.codec_descs)
-        # initial states: host-enumerated by the interpreter (exact
-        # parity), encoded once into a gatherable device table
-        init_states = spec.initial_states()
-        self.n_initial = len(init_states)
-        self._init_list = init_states
-        rows = []
-        for s in init_states:
-            d = {
-                v: encode_value(self.var_descs[v], val)
-                for v, val in zip(spec.vars, s)
-            }
-            d[ERR_VAR] = np.bool_(False)
-            rows.append(d)
-        self._init_table = jax.tree_util.tree_map(
-            lambda *xs: jnp.asarray(np.stack(xs)), *rows
-        )
+        # initial states: a mixed-radix counting kernel when Init is a
+        # recognizable cross product of per-variable draws (the
+        # reference's ModelProducer=FALSE Init is (K*V)^M states —
+        # enumeration explodes where counting is free); otherwise
+        # host-enumerated by the interpreter (exact parity) and encoded
+        # once into a gatherable device table
+        self._factored_init = _try_factor_init(spec, self.var_descs)
+        if self._factored_init is not None:
+            self.n_initial = self._factored_init.n
+            self._init_table = None
+        else:
+            init_states = spec.initial_states()
+            self.n_initial = len(init_states)
+            rows = []
+            for s in init_states:
+                d = {
+                    v: encode_value(self.var_descs[v], val)
+                    for v, val in zip(spec.vars, s)
+                }
+                d[ERR_VAR] = np.bool_(False)
+                rows.append(d)
+            self._init_table = jax.tree_util.tree_map(
+                lambda *xs: jnp.asarray(np.stack(xs)), *rows
+            )
         # concrete lane structure (fixed by descs; probe with abstract
         # pass to learn labels/count)
         probe = ActionCompiler(spec, primed=True)
@@ -1972,7 +2439,9 @@ class CompiledSpec:
     # -- model protocol ------------------------------------------------
 
     def gen_initial(self, idx):
-        i = jnp.clip(idx, 0, self.n_initial - 1)
+        i = jnp.clip(idx, 0, min(self.n_initial, (1 << 31) - 1) - 1)
+        if self._factored_init is not None:
+            return self._factored_init.gen(i)
         return jax.tree_util.tree_map(lambda x: x[i], self._init_table)
 
     def successors(self, state):
@@ -2052,6 +2521,44 @@ class CompiledSpec:
 
         return fn
 
+    @property
+    def liveness_goals(self):
+        """Named ``<>(predicate)`` temporal properties compiled to state
+        predicate kernels (VERDICT r3 #5: the fragment ``Termination``
+        uses, /root/reference/compaction.tla:303-307).  A definition
+        qualifies when its body is an eventually-applied state
+        predicate; the body compiles through the same pipeline as an
+        invariant, so it runs vmapped on device in the liveness
+        engine's goal sweep."""
+        from pulsar_tlaplus_tpu.frontend import tla_ast as A
+
+        out = {}
+        for name, d in self.spec.defs.items():
+            body = d.body
+            if isinstance(body, A.UnOp) and body.op == "<>":
+                out[name] = self._goal_fn(name, body.expr)
+        return out
+
+    def _goal_fn(self, name: str, body):
+        def fn(state):
+            c = Compiler(self.spec)
+            cenv = CEnv(
+                {
+                    v: ("cv", CVal(self.var_descs[v], state[v]))
+                    for v in self.spec.vars
+                }
+            )
+            cv = c.cbool(body, cenv)
+            ok = cv.data
+            if cv.poison is not FALSE:
+                # an evaluation error inside the goal body counts as
+                # not-goal (TLC would raise; the engine surfaces the
+                # __EvalError__ invariant separately)
+                ok = ok & ~jnp.asarray(cv.poison)
+            return ok
+
+        return fn
+
     def _eval_error_fn(self):
         """Auto-invariant: no lane reached this state through poisoned
         Init/Next evaluation (the ``ERR_VAR`` bit), and no requested
@@ -2074,13 +2581,33 @@ class CompiledSpec:
         shapes) so unsupported constructs fail at build time, not mid
         check."""
         dummy = jax.tree_util.tree_map(
-            lambda x: x[0], self._init_table
+            jnp.asarray, self.gen_initial(jnp.int32(0))
         )
         jax.eval_shape(self.successors, dummy)
         for name, fn in self.invariants.items():
             jax.eval_shape(fn, dummy)
 
     # -- trace rendering / replay -------------------------------------
+
+    @property
+    def config_sig(self) -> str:
+        """Stable identity of (module, constants binding) for
+        checkpoint-compatibility checks (engine/bfs.py E8)."""
+        return repr(
+            (
+                self.spec.module.name,
+                sorted(
+                    (k, repr(v)) for k, v in self.spec.constants.items()
+                ),
+            )
+        )
+
+    def to_pystate(self, state):
+        """Generic model protocol for the host-staged engines
+        (engine/core.build_trace, engine/simulate): returns the
+        rendered variable mapping, which utils.render prints in TLC
+        trace format."""
+        return self.render_state(state)
 
     def decode_state(self, state) -> Dict[str, object]:
         host = jax.tree_util.tree_map(np.asarray, state)
@@ -2103,7 +2630,7 @@ class CompiledSpec:
         ``init_idx``-th initial state (device engine E7 protocol)."""
         step = jax.jit(self.successors)
         s = jax.tree_util.tree_map(
-            lambda x: x[init_idx], self._init_table
+            jnp.asarray, self.gen_initial(jnp.int32(init_idx))
         )
         states = [self.render_state(s)]
         actions = []

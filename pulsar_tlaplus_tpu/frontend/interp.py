@@ -322,6 +322,7 @@ class Spec:
 
     def __init__(self, module: A.Module, constants: Dict[str, object]):
         self.module = module
+        self.constants = dict(constants)
         self.defs = module.defs_by_name()
         missing = [c for c in module.constants if c not in constants]
         if missing:
