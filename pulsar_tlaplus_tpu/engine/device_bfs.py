@@ -218,8 +218,8 @@ class DeviceChecker:
         return fn
 
     def _expand_jit(self):
-        """(ak cols, flat arows[ACAP*W], flat window[G*W], f_off,
-        n_live, dead_gid, gid_base, acc_off) -> (ak', arows',
+        """(ak cols, arows[W, ACAP] (word-major SoA), flat window[G*W],
+        f_off, n_live, dead_gid, gid_base, acc_off) -> (ak', arows',
         dead_gid').
 
         Expands one G-state window into ``NCs`` candidate lanes and
@@ -281,7 +281,7 @@ class DeviceChecker:
                 for akc, kc in zip(ak, kcols)
             )
             arows = lax.dynamic_update_slice(
-                arows, packed.reshape(nc * W), (acc_off * W,)
+                arows, packed.reshape(nc, W).T, (0, acc_off)
             )
             return (*ak, arows, dead)
 
@@ -330,7 +330,7 @@ class DeviceChecker:
                 for akc, kc in zip(ak, kcols)
             )
             arows = lax.dynamic_update_slice(
-                arows, packed.reshape(NCs * W), (acc_off * W,)
+                arows, packed.reshape(NCs, W).T, (0, acc_off)
             )
             return (*ak, arows)
 
@@ -340,13 +340,13 @@ class DeviceChecker:
 
     def _flush_jit(self):
         """Sort-merge the accumulator into the visited set: (vk cols,
-        ak cols, n_acc) -> (vk' cols, n_new, new_pay[ACAP]).
+        ak cols, n_acc) -> (vk' cols, n_new, flag_acc[ACAP]).
 
         One unstable ``K+1``-operand sort resolves in-accumulator
         duplicates AND visited membership in the same pass (payload 0 =
         visited orders before same-key candidates); a stable flag-sort
-        compacts the merged visited set; a stable 2-operand flag-sort
-        compacts the surviving candidates' payloads to the front."""
+        compacts the merged visited set; a payload sort projects the
+        new-state flags back to accumulator slot order."""
         key = ("flush", self.VCAP)
         if key in self._jits:
             return self._jits[key]
@@ -365,96 +365,109 @@ class DeviceChecker:
             vk2, n_new, sp, new_flag = dedup.merge_new_keys(
                 vk, ccols, cpay
             )
-            nn = (~new_flag).astype(jnp.uint32)
-            _, new_pay = lax.sort((nn, sp), num_keys=1, is_stable=True)
-            return (*vk2, n_new, new_pay[:ACAP])
+            # project new_flag back to ACCUMULATOR order: candidate
+            # payloads (idx | TAG) sort above every visited payload (0)
+            # and ascend in idx order, so the tail of a payload sort is
+            # the per-slot flag vector — the append then compacts rows
+            # with a value-carrying sort instead of a gather (gathers
+            # are latency-bound per element on TPU: an appended flush
+            # measured 10.9 s/8.9M lanes before this, profile_stages)
+            _, flag_sorted = lax.sort(
+                (sp, new_flag.astype(jnp.uint32)), num_keys=1,
+                is_stable=False,
+            )
+            flag_acc = flag_sorted[sp.shape[0] - ACAP:]
+            return (*vk2, n_new, flag_acc)
 
         fn = jax.jit(step, donate_argnums=tuple(range(self.K)))
         self._jits[key] = fn
         return fn
 
-    # gather/DUS chunk for the append scan: bounds the transient tiled
-    # buffers one chunk materializes (gather result + unpacked states +
-    # invariant intermediates, all proportional to SL lanes; a
-    # full-ACAP gather would be 17 GB at bench shapes — measured,
-    # profile_lsm.py)
-    SL = 1 << 14
+    # invariant-evaluation chunk for the append: bounds the unpacked-
+    # state / invariant intermediates (all proportional to SL lanes; a
+    # full-ACAP unpack is multi-GB at bench shapes)
+    SL = 1 << 17
 
     def _append_core_jit(self, is_init: bool):
-        """Collect the flush's new states: a chunked scan gathers each
-        SL-slice of new rows from the accumulator, derives parent gids /
-        action lanes, and evaluates the invariants on exactly the new
-        states (deduped — round 2 paid this on every candidate lane).
+        """Collect the flush's new states WITHOUT any gather: a stable
+        value-carrying sort on the acc-order new-flag compacts the W
+        word columns (plus the slot iota for parent/lane derivation) to
+        the front in discovery order.  Gathers are latency-bound per
+        element on TPU (~50 ns — a gather-based append measured 10.9 s
+        per 8.9M lanes, profile_stages.py); this sort costs
+        ``(W+2) * ACAP`` bandwidth-bound sort traffic instead.
 
-        The row gather is chunked because a [n, W] gather result
-        materializes in the TPU tiled layout (minor dim padded to 128 —
-        6.4x memory, measured in profile_lsm.py); each [SL, W] chunk is
-        relayouted into the packed [APAD, W] output as the scan stacks.
-        Kept separate from the store writer so the multi-GB row store
-        itself never enters a gather computation and keeps its packed
-        layout."""
+        Invariants then evaluate on exactly the new states (deduped —
+        round 2 paid this on every candidate lane) in SL-sized scan
+        chunks of the compacted columns."""
         key = ("appcore", is_init)
         if key in self._jits:
             return self._jits[key]
-        A, W = self.A, self.W
+        A, W, ACAP = self.A, self.W, self.ACAP
         SL, C = self.SLc, self.C
         layout = self.layout
         inv_fns = [self.model.invariants[n] for n in self.invariant_names]
         n_inv = len(self.invariant_names)
 
-        def step(arows, new_pay, n_new, n_visited, viol, acc_base):
-            if C * SL > new_pay.shape[0]:
-                # the scan covers C*SL = APAD >= ACAP lanes; pad so the
-                # last chunk's dynamic_slice can never clamp and replay
-                # earlier payloads into live tail lanes
-                new_pay = jnp.concatenate(
-                    [
-                        new_pay,
-                        jnp.zeros((C * SL - new_pay.shape[0],), jnp.uint32),
-                    ]
+        def step(arows, flag_acc, n_new, n_visited, viol, acc_base):
+            drop = (flag_acc ^ jnp.uint32(1)).astype(jnp.uint32)
+            cols = tuple(arows[j] for j in range(W))
+            iota = jnp.arange(ACAP, dtype=jnp.uint32)
+            out = lax.sort(
+                (drop, *cols, iota), num_keys=1, is_stable=True
+            )
+            ccols, ciota = out[1: W + 1], out[W + 1]
+            idx = ciota.astype(jnp.int32)
+            lanei = jnp.arange(ACAP, dtype=jnp.int32)
+            live = lanei < n_new
+            if is_init:
+                par = -1 - (acc_base + idx)
+                lane = jnp.zeros((ACAP,), jnp.int32)
+            else:
+                par = acc_base + idx // A
+                lane = idx % A
+            par = jnp.where(live, par, 0)
+            lane = jnp.where(live, lane, 0)
+            if n_inv:
+                # pad so the eval chunks can never clamp mid-window
+                pad = C * SL - ACAP
+                ecols = (
+                    tuple(
+                        jnp.concatenate(
+                            [c, jnp.zeros((pad,), jnp.uint32)]
+                        )
+                        for c in ccols
+                    )
+                    if pad
+                    else ccols
                 )
 
-            def chunk(viol, c):
-                lanei = c * SL + jnp.arange(SL, dtype=jnp.int32)
-                live = lanei < n_new
-                pay = lax.dynamic_slice(new_pay, (c * SL,), (SL,))
-                idx = (pay & IDX_MASK).astype(jnp.int32)
-                # dead lanes gather row 0 (cache-resident), so gather
-                # cost tracks n_new, not ACAP; rows are W-word slices
-                # of the flat accumulator
-                safe = jnp.where(live, idx, 0)
-                src = jax.vmap(
-                    lambda i: lax.dynamic_slice(arows, (i * W,), (W,))
-                )(safe)
-                if is_init:
-                    par = -1 - (acc_base + idx)
-                    lane = jnp.zeros((SL,), jnp.int32)
-                else:
-                    par = acc_base + idx // A
-                    lane = idx % A
-                par = jnp.where(live, par, 0)
-                lane = jnp.where(live, lane, 0)
-                if n_inv:
-                    states = jax.vmap(layout.unpack)(src)
-                    gids = n_visited + lanei
+                def chunk(viol, c):
+                    off = c * SL
+                    rows = jnp.stack(
+                        [
+                            lax.dynamic_slice(col, (off,), (SL,))
+                            for col in ecols
+                        ],
+                        axis=1,
+                    )
+                    gids = n_visited + off + jnp.arange(
+                        SL, dtype=jnp.int32
+                    )
+                    livec = off + jnp.arange(SL, dtype=jnp.int32) < n_new
+                    states = jax.vmap(layout.unpack)(rows)
                     vnew = []
                     for fn in inv_fns:
                         ok = jax.vmap(fn)(states)
-                        bad = live & ~ok
+                        bad = livec & ~ok
                         vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
-                    viol = jnp.minimum(viol, jnp.stack(vnew))
-                return viol, (src, par, lane)
+                    return jnp.minimum(viol, jnp.stack(vnew)), None
 
-            viol, (rows, par, lane) = lax.scan(
-                chunk, viol, jnp.arange(C, dtype=jnp.int32)
-            )
-            return (
-                rows.reshape(C * SL * W),
-                par.reshape(C * SL),
-                lane.reshape(C * SL),
-                n_visited + n_new,
-                viol,
-            )
+                viol, _ = lax.scan(
+                    chunk, viol, jnp.arange(C, dtype=jnp.int32)
+                )
+            rows_flat = jnp.stack(ccols, axis=1).reshape(ACAP * W)
+            return rows_flat, par, lane, n_visited + n_new, viol
 
         fn = jax.jit(step)
         self._jits[key] = fn
@@ -728,7 +741,7 @@ class DeviceChecker:
                     jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
                     for _ in range(K)
                 ),
-                z((self.ACAP * self.W,), jnp.uint32),
+                z((self.W, self.ACAP), jnp.uint32),
             )
 
         ak, arows = acc()
@@ -751,11 +764,11 @@ class DeviceChecker:
         out = self._flush_jit()(*vk, *ak, jnp.int32(0))
         drain(out)
         del vk
-        new_pay = out[K + 1]
+        flag_w = out[K + 1]
         viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
         for is_init in (True, False):
             app = self._append_core_jit(is_init)(
-                arows, new_pay, jnp.int32(0), jnp.int32(0), viol0,
+                arows, flag_w, jnp.int32(0), jnp.int32(0), viol0,
                 jnp.int32(0),
             )
             drain(app)
@@ -770,7 +783,7 @@ class DeviceChecker:
                 rows_w, par_w, lane_w, jnp.int32(0),
             )
         )
-        del ak, arows, new_pay, rows_w, par_w, lane_w
+        del ak, arows, flag_w, rows_w, par_w, lane_w
         drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
         drain(
             self._chain_jit(4)(
@@ -823,7 +836,7 @@ class DeviceChecker:
                 jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
                 for _ in range(K)
             ),
-            "arows": jnp.zeros((self.ACAP * self.W,), jnp.uint32),
+            "arows": jnp.zeros((self.W, self.ACAP), jnp.uint32),
             "rows": jnp.zeros((self.LCAP * self.W,), jnp.uint32),
             "parent": jnp.zeros((self.LCAP,), jnp.int32),
             "lane": jnp.zeros((self.LCAP,), jnp.int32),
@@ -854,11 +867,11 @@ class DeviceChecker:
                 *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
             )
             bufs["vk"] = out[:K]
-            n_new, new_pay = out[K], out[K + 1]
+            n_new, flag_acc = out[K], out[K + 1]
             rows, par, lane, n_vis2, viol2 = self._append_core_jit(
                 is_init
             )(
-                bufs["arows"], new_pay, n_new, st["n_visited"],
+                bufs["arows"], flag_acc, n_new, st["n_visited"],
                 st["viol"], jnp.int32(acc_base),
             )
             bufs["rows"], bufs["parent"], bufs["lane"] = (

@@ -52,7 +52,7 @@ class LivenessChecker:
         fairness: str = "none",
         frontier_chunk: int = 2048,
         visited_cap: int = 1 << 14,
-        max_states: int = 5_000_000,
+        max_states: int = 50_000_000,
     ):
         goals = getattr(model, "liveness_goals", {})
         if goal not in goals:
@@ -66,16 +66,20 @@ class LivenessChecker:
         self.goal_fn = goals[goal]
         self.fairness = fairness
         self.F = frontier_chunk
-        from pulsar_tlaplus_tpu.engine.bfs import Checker
+        from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
 
-        self._checker = Checker(
+        # exploration runs on the device-resident engine (VERDICT r2
+        # #8: the round-2 host-staged explorer capped liveness at small
+        # state spaces); its append-only row store IS the packed state
+        # matrix, streamed to the host once for the edge sweep
+        self._checker = DeviceChecker(
             model,
             invariants=(),
             check_deadlock=False,
-            frontier_chunk=frontier_chunk,
+            sub_batch=max(256, frontier_chunk),
             visited_cap=visited_cap,
+            frontier_cap=visited_cap,
             max_states=max_states,
-            keep_log=True,
         )
         self._explored = None  # (packed, n, n_init) — shared across goals
         self._edge_cache = None  # (src, dst, out_deg) — goal-independent
@@ -88,9 +92,11 @@ class LivenessChecker:
         res = self._checker.run()
         if res.truncated:
             raise RuntimeError("state space exceeded liveness max_states")
-        rs = self._checker.last_run_state
-        packed = rs.log.packed_matrix()
-        self._explored = (packed, len(packed), rs.level_sizes[0])
+        n = res.distinct_states
+        W = self.model.layout.W
+        rows = self._checker.last_bufs["rows"]
+        packed = np.asarray(rows[: n * W]).reshape(n, W)
+        self._explored = (packed, n, res.level_sizes[0])
         return self._explored
 
     def run_goal(self, goal: str) -> LivenessResult:

@@ -108,28 +108,21 @@ def _route_accumulate(
     s_cols = [send1(c, SENTINEL) for c in kcols]
     s_par = send1(par, jnp.int32(0))
     s_lane = send1(lane, jnp.int32(0))
-    # rows: word-granularity flat scatter (keeps everything 1-D; a
-    # [L, W] scatter would force tiled layouts)
-    qw = q[:, None] * W + jnp.arange(W, dtype=jnp.int32)[None, :]
-    s_rows = (
-        jnp.zeros((N * CAPO * W,), jnp.uint32)
-        .at[qw.reshape(L * W)]
-        .set(packed.reshape(L * W), mode="drop", unique_indices=True)
-    )
+    # state words route as W more columns of the same stacked
+    # all_to_all (the accumulator is word-major SoA, so received
+    # columns land with one 2-D DUS; no per-word scatter)
+    s_words = [send1(packed[:, j], jnp.uint32(0)) for j in range(W)]
     stack = jnp.stack(
         [c.astype(jnp.uint32) for c in s_cols]
         + [
             lax.bitcast_convert_type(s_par, jnp.uint32),
             lax.bitcast_convert_type(s_lane, jnp.uint32),
         ]
-    ).reshape(K + 2, N, CAPO)
+        + s_words
+    ).reshape(K + 2 + W, N, CAPO)
     r_stack = lax.all_to_all(
         stack, AXIS, split_axis=1, concat_axis=1, tiled=False
-    ).reshape(K + 2, N * CAPO)
-    r_rows = lax.all_to_all(
-        s_rows.reshape(N, CAPO * W), AXIS, split_axis=0,
-        concat_axis=0, tiled=False,
-    ).reshape(N * CAPO * W)
+    ).reshape(K + 2 + W, N * CAPO)
     ak = tuple(
         lax.dynamic_update_slice(a, r_stack[i], (acc_off,))
         for i, a in enumerate(ak)
@@ -142,7 +135,9 @@ def _route_accumulate(
         lax.bitcast_convert_type(r_stack[K + 1], jnp.int32),
         (acc_off,),
     )
-    arows = lax.dynamic_update_slice(arows, r_rows, (acc_off * W,))
+    arows = lax.dynamic_update_slice(
+        arows, r_stack[K + 2:], (0, acc_off)
+    )
     return ak, arows, apar, alane, over
 
 
@@ -444,11 +439,18 @@ class ShardedDeviceChecker:
             vk2, n_new, sp, new_flag = dedup.merge_new_keys(
                 vk, ccols, cpay
             )
-            nn = (~new_flag).astype(jnp.uint32)
-            _, new_pay = lax.sort((nn, sp), num_keys=1, is_stable=True)
+            # project the new-flag back to accumulator slot order
+            # (candidate payloads sort above visited zeros, ascending
+            # by slot) — the append compacts with a value-carrying
+            # sort; gathers are latency-bound per element on TPU
+            _, flag_sorted = lax.sort(
+                (sp, new_flag.astype(jnp.uint32)), num_keys=1,
+                is_stable=False,
+            )
+            flag_acc = flag_sorted[sp.shape[0] - ACAP:]
             return (
                 tuple(v[None] for v in vk2), n_new[None],
-                new_pay[:ACAP][None],
+                flag_acc[None],
             )
 
         sh = P(AXIS)
@@ -461,68 +463,94 @@ class ShardedDeviceChecker:
         return fn
 
     def _append_jit(self):
-        """Per-shard append of the flush's new states: chunked gathers
-        from the accumulator (rows + routed parent/lane), invariant
-        evaluation on exactly the new states, blind DUS windows into the
-        local row store and trace logs."""
+        """Per-shard append of the flush's new states, gather-free: a
+        stable value-carrying sort on the acc-order new-flag compacts
+        the word columns + routed parent/lane to the front in arrival
+        order (gathers are latency-bound per element on TPU); invariants
+        evaluate on exactly the new states in SL-sized chunks; one DUS
+        lands rows + logs in the local store."""
         key = ("append", self.LCAP)
         if key in self._jits:
             return self._jits[key]
-        W = self.W
+        W, ACAP = self.W, self.ACAP
         SL, C = self.SLc, self.C
         layout = self.layout
         inv_fns = [self.model.invariants[n] for n in self.invariant_names]
         n_inv = len(self.invariant_names)
 
-        def body(rows, parent_log, lane_log, arows, apar, alane, new_pay,
-                 n_new, n_visited, viol):
+        def body(rows, parent_log, lane_log, arows, apar, alane,
+                 flag_acc, n_new, n_visited, viol):
             rows, parent_log, lane_log = rows[0], parent_log[0], lane_log[0]
             arows, apar, alane = arows[0], apar[0], alane[0]
-            new_pay, n_new = new_pay[0], n_new[0]
+            flag_acc, n_new = flag_acc[0], n_new[0]
             n_visited, viol = n_visited[0], viol[0]
             shard = lax.axis_index(AXIS).astype(jnp.int32)
-            if C * SL > new_pay.shape[0]:
-                new_pay = jnp.concatenate(
-                    [
-                        new_pay,
-                        jnp.zeros((C * SL - new_pay.shape[0],), jnp.uint32),
-                    ]
+            drop = (flag_acc ^ jnp.uint32(1)).astype(jnp.uint32)
+            cols = tuple(arows[j] for j in range(W))
+            out = lax.sort(
+                (
+                    drop, *cols,
+                    lax.bitcast_convert_type(apar, jnp.uint32),
+                    lax.bitcast_convert_type(alane, jnp.uint32),
+                ),
+                num_keys=1, is_stable=True,
+            )
+            ccols = out[1: W + 1]
+            par = lax.bitcast_convert_type(out[W + 1], jnp.int32)
+            lane = lax.bitcast_convert_type(out[W + 2], jnp.int32)
+            lanei = jnp.arange(ACAP, dtype=jnp.int32)
+            live = lanei < n_new
+            par = jnp.where(live, par, 0)
+            lane = jnp.where(live, lane, 0)
+            if n_inv:
+                pad = C * SL - ACAP
+                ecols = (
+                    tuple(
+                        jnp.concatenate(
+                            [c, jnp.zeros((pad,), jnp.uint32)]
+                        )
+                        for c in ccols
+                    )
+                    if pad
+                    else ccols
                 )
 
-            def chunk(carry, c):
-                rows, parent_log, lane_log, viol = carry
-                lanei = c * SL + jnp.arange(SL, dtype=jnp.int32)
-                live = lanei < n_new
-                pay = lax.dynamic_slice(new_pay, (c * SL,), (SL,))
-                idx = (pay & IDX_MASK).astype(jnp.int32)
-                safe = jnp.where(live, idx, 0)
-                src = jax.vmap(
-                    lambda i: lax.dynamic_slice(arows, (i * W,), (W,))
-                )(safe)
-                par = jnp.where(live, apar[safe], 0)
-                lane = jnp.where(live, alane[safe], 0)
-                if n_inv:
-                    states = jax.vmap(layout.unpack)(src)
-                    gids = (shard << self.SB) | (n_visited + lanei)
+                def chunk(viol, c):
+                    off = c * SL
+                    rws = jnp.stack(
+                        [
+                            lax.dynamic_slice(col, (off,), (SL,))
+                            for col in ecols
+                        ],
+                        axis=1,
+                    )
+                    gids = (shard << self.SB) | (
+                        n_visited + off
+                        + jnp.arange(SL, dtype=jnp.int32)
+                    )
+                    livec = (
+                        off + jnp.arange(SL, dtype=jnp.int32) < n_new
+                    )
+                    states = jax.vmap(layout.unpack)(rws)
                     vnew = []
                     for fn in inv_fns:
                         ok = jax.vmap(fn)(states)
-                        bad = live & ~ok
+                        bad = livec & ~ok
                         vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
-                    viol = jnp.minimum(viol, jnp.stack(vnew))
-                off = n_visited + c * SL
-                rows = lax.dynamic_update_slice(
-                    rows, src.reshape(SL * W), (off * W,)
-                )
-                parent_log = lax.dynamic_update_slice(
-                    parent_log, par, (off,)
-                )
-                lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
-                return (rows, parent_log, lane_log, viol), None
+                    return jnp.minimum(viol, jnp.stack(vnew)), None
 
-            (rows, parent_log, lane_log, viol), _ = lax.scan(
-                chunk, (rows, parent_log, lane_log, viol),
-                jnp.arange(C, dtype=jnp.int32),
+                viol, _ = lax.scan(
+                    chunk, viol, jnp.arange(C, dtype=jnp.int32)
+                )
+            rows_flat = jnp.stack(ccols, axis=1).reshape(ACAP * W)
+            rows = lax.dynamic_update_slice(
+                rows, rows_flat, (n_visited * W,)
+            )
+            parent_log = lax.dynamic_update_slice(
+                parent_log, par, (n_visited,)
+            )
+            lane_log = lax.dynamic_update_slice(
+                lane_log, lane, (n_visited,)
             )
             return (
                 rows[None], parent_log[None], lane_log[None],
@@ -622,7 +650,7 @@ class ShardedDeviceChecker:
                 jnp.full((N, self.ACAP), SENTINEL, jnp.uint32, device=sh)
                 for _ in range(K)
             ),
-            "arows": jnp.zeros((N, self.ACAP * self.W), jnp.uint32,
+            "arows": jnp.zeros((N, self.W, self.ACAP), jnp.uint32,
                                device=sh),
             "apar": jnp.zeros((N, self.ACAP), jnp.int32, device=sh),
             "alane": jnp.zeros((N, self.ACAP), jnp.int32, device=sh),

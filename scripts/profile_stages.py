@@ -29,6 +29,7 @@ def barrier(o):
 def main():
     g_log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 19
     flush_factor = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    sl_log2 = int(sys.argv[3]) if len(sys.argv) > 3 else None
     from pulsar_tlaplus_tpu.engine.device_bfs import BIG, DeviceChecker
     from pulsar_tlaplus_tpu.models.compaction import CompactionModel
     from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
@@ -48,11 +49,12 @@ def main():
         frontier_cap=(24_000_000 + (1 << g_log2) * model.A * flush_factor),
         max_states=24_000_000,
         flush_factor=flush_factor,
+        append_chunk=None if sl_log2 is None else (1 << sl_log2),
     )
     print(
         f"device {jax.devices()[0]}; G={ck.G} A={ck.A} NCs={ck.NCs} "
         f"ACAP={ck.ACAP} APAD={ck.APAD} K={ck.K} VCAP={ck.VCAP} "
-        f"LCAP={ck.LCAP} W={ck.W}", flush=True,
+        f"LCAP={ck.LCAP} W={ck.W} SL={ck.SLc} C={ck.C}", flush=True,
     )
     t0 = time.time()
     warm_s = ck.warmup()
