@@ -92,6 +92,16 @@ class LivenessChecker:
         res = self._checker.run()
         if res.truncated:
             raise RuntimeError("state space exceeded liveness max_states")
+        if res.violation is not None:
+            # DeviceChecker force-appends __EvalError__ for compiled
+            # specs even with invariants=(); ANY early stop means the
+            # explored graph is partial, and a liveness verdict over a
+            # partial graph would be silently wrong (ADVICE r3, medium)
+            raise RuntimeError(
+                "exploration stopped early on a violation "
+                f"({res.violation}); liveness requires the full state "
+                "graph — fix the safety violation first"
+            )
         n = res.distinct_states
         W = self.model.layout.W
         rows = self._checker.last_bufs["rows"]

@@ -207,6 +207,8 @@ class ShardedDeviceChecker:
         self.ACAP = self.RCV * flush_factor  # accumulator lanes per shard
         self.keys = KeySpec(self.layout.total_bits, self.W, fp_bits)
         self.K = self.keys.ncols
+        if fp_bits is None:
+            self.keys.warn_if_hashed(max_states)
         self.SL = append_chunk or (1 << 14)
         self.SLc = min(self.SL, self.ACAP)
         self.C = -(-self.ACAP // self.SLc)
@@ -833,6 +835,12 @@ class ShardedDeviceChecker:
         return None
 
     def _first_viol(self, stats) -> Optional[Tuple[str, int]]:
+        """Lowest-global-gid violation across shards.  Global gids are
+        ``shard << SB | local``, so among violations discovered in the
+        same level the minimum is biased toward low shard indices rather
+        than strict discovery order — the reported counterexample can be
+        a *different* (equally minimal-depth, equally valid) trace than
+        the single-chip engine picks for the same spec (ADVICE r3)."""
         best = None
         for i, name in enumerate(self.invariant_names):
             g = int(stats[:, 2 + i].min())
@@ -881,7 +889,13 @@ class ShardedDeviceChecker:
             lane = int(np.asarray(lane_log[s, idx]))
             chain.append((g, lane))
             g = int(np.asarray(par_log[s, idx]))
-        assert g < 0, "root of parent chain must be an initial state"
+        if g >= 0:
+            # a corrupted chain must never fall through to a nonsense
+            # init_idx replay (and asserts vanish under python -O)
+            raise RuntimeError(
+                "parent chain did not terminate at an initial state "
+                f"(depth {max_depth}, last gid {g}) — trace log corrupt"
+            )
         init_idx = -1 - g
         chain.reverse()
         return self.model.replay_trace(

@@ -88,7 +88,16 @@ def run_baseline(
     )
     if p.returncode not in (0, 1):
         raise RuntimeError(f"baseline checker failed: {p.stderr[:500]}")
-    return json.loads(p.stdout.strip().splitlines()[-1])
+    res = json.loads(p.stdout.strip().splitlines()[-1])
+    if res.get("violated"):
+        # a violated run stops BFS early — its states/sec is measured
+        # against a partial exploration and must never be used as a
+        # throughput baseline (ADVICE r3)
+        raise RuntimeError(
+            "native baseline run hit an invariant violation; its "
+            f"partial-run throughput is not a valid baseline: {res}"
+        )
+    return res
 
 
 def load_logstore():

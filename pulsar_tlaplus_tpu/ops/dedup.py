@@ -130,6 +130,25 @@ class KeySpec:
         h[-1] = jnp.where(all_sent, h[-1] ^ jnp.uint32(1), h[-1])
         return tuple(h)
 
+    def warn_if_hashed(self, max_states: int):
+        """One stderr note when hashed-fingerprint mode engages by
+        default (ADVICE r3): dedup turned probabilistic silently for
+        wide states — surface it up front, not only in the final
+        report.  Engines call this when the caller did not pick
+        ``fp_bits`` explicitly."""
+        if self.exact:
+            return
+        import sys
+
+        print(
+            f"note: state is {self.total_bits} bits wide -> "
+            f"{32 * self.ncols}-bit hashed fingerprints (TLC's regime); "
+            f"expected fp collisions at {max_states} states: "
+            f"{self.collision_prob(max_states):.3g} "
+            "(fp_bits=96 available)",
+            file=sys.stderr,
+        )
+
     def collision_prob(self, n_states: int) -> float:
         """Expected number of fingerprint collisions at ``n_states``
         distinct states (birthday bound) — 0.0 in exact mode.  TLC

@@ -60,6 +60,7 @@ def main():
     warm_s = ck.warmup()
     print(f"warmup compile: {warm_s:.1f}s (wall {time.time()-t0:.1f}s)",
           flush=True)
+    print(f"  compile breakdown: {ck.last_stats}", flush=True)
 
     K = ck.K
     z = jnp.zeros
