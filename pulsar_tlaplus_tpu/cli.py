@@ -293,15 +293,14 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
         return 1 if sres.violation else 0
     if args.sharded and (
         args.sharded_engine == "device"
-        and args.slices == 1
         and args.sharded_dedup == "sort"
-        and not args.checkpoint
-        and not args.recover
     ):
         from pulsar_tlaplus_tpu.engine.sharded_device import (
             ShardedDeviceChecker,
         )
 
+        if args.slices > 1 and args.sharded % args.slices:
+            sys.exit("tpu-tlc: -sharded must be divisible by -slices")
         ck = ShardedDeviceChecker(
             model,
             n_devices=args.sharded,
@@ -311,13 +310,14 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             max_states=args.maxstates,
             metrics_path=args.metrics,
             progress=True,
+            checkpoint_path=args.checkpoint,
+            n_slices=args.slices,
         )
     elif args.sharded:
         if args.sharded_engine == "device":
             print(
-                "tpu-tlc: note: -slices/-sharded-dedup hash/-checkpoint "
-                "need the host-staged sharded driver; using "
-                "-sharded-engine host"
+                "tpu-tlc: note: -sharded-dedup hash needs the "
+                "host-staged sharded driver; using -sharded-engine host"
             )
         from pulsar_tlaplus_tpu.engine.sharded import ShardedChecker
 
@@ -414,9 +414,9 @@ def main(argv=None):
         default="device",
         help="sharded implementation: 'device' = fully device-resident "
         "(all_to_all candidate routing inside the jitted step; "
+        "supports -slices 2-D meshes and -checkpoint/-recover; "
         "default) or 'host' = the round-2 host-staged driver (needed "
-        "for 2-D -slices meshes, -sharded-dedup hash, and "
-        "-checkpoint)",
+        "only for -sharded-dedup hash)",
     )
     pc.add_argument(
         "-invariant",

@@ -1,0 +1,112 @@
+"""Primitive cost curves on the real chip that decide the round-4
+redesign: XLA sort compile+run time vs operand count and width, random
+gather/scatter rates (hash-table alternative), and stable-vs-unstable
+single-key sorts (append-core alternative).
+
+Usage: python scripts/profile_prims2.py [case ...]
+cases: sorts, big, gather, all (default: all)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def barrier(o):
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    np.asarray(jnp.ravel(leaf)[0])
+
+
+def timed(tag, fn, *args, iters=4):
+    t0 = time.time()
+    out = fn(*args)
+    barrier(out)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    barrier(out)
+    run_s = (time.time() - t0) / iters
+    print(f"{tag:44s} compile {compile_s:7.1f}s   run {run_s*1e3:9.1f} ms",
+          flush=True)
+    return compile_s, run_s
+
+
+def rng_cols(n, k, seed=0):
+    key = jax.random.PRNGKey(seed)
+    cols = []
+    for i in range(k):
+        key, sub = jax.random.split(key)
+        cols.append(jax.random.bits(sub, (n,), jnp.uint32))
+    return cols
+
+
+def case_sorts():
+    n = 1 << 23  # 8.4M ~ accumulator width
+    for ops, stable in [(2, False), (3, False), (6, False), (11, False),
+                        (21, False), (21, True), (22, True)]:
+        cols = rng_cols(n, ops)
+
+        def f(*cs):
+            return lax.sort(cs, num_keys=1, is_stable=stable)
+
+        jf = jax.jit(f)
+        timed(f"sort n=2^23 ops={ops} stable={int(stable)}", jf, *cols)
+        jf._clear_cache()
+
+
+def case_big():
+    for logn in (25, 26):
+        n = 1 << logn
+        for ops, nk in [(3, 3), (3, 1), (4, 4)]:
+            cols = rng_cols(n, ops)
+
+            def f(*cs):
+                return lax.sort(cs, num_keys=nk, is_stable=False)
+
+            jf = jax.jit(f)
+            timed(f"sort n=2^{logn} ops={ops} keys={nk}", jf, *cols)
+            jf._clear_cache()
+
+
+def case_gather():
+    # random gather/scatter at hash-table shapes: table 2^27, 8.4M probes
+    t = 1 << 27
+    n = 1 << 23
+    tab = jax.random.bits(jax.random.PRNGKey(1), (t,), jnp.uint32)
+    idx = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, t, jnp.int32)
+    sidx = jnp.sort(idx)
+
+    g = jax.jit(lambda tb, ix: tb[ix])
+    timed("gather 2^23 random from 2^27", g, tab, idx)
+    timed("gather 2^23 sorted-idx from 2^27", g, tab, sidx)
+
+    sc = jax.jit(
+        lambda tb, ix, v: tb.at[ix].set(v, mode="drop", unique_indices=True)
+    )
+    vals = jax.random.bits(jax.random.PRNGKey(3), (n,), jnp.uint32)
+    timed("scatter 2^23 random into 2^27", sc, tab, idx, vals)
+    timed("scatter 2^23 sorted into 2^27", sc, tab, sidx, vals)
+
+    # 2-word-payload gather (64-bit fp table as 2 planes)
+    tab2 = jax.random.bits(jax.random.PRNGKey(4), (2, t), jnp.uint32)
+    g2 = jax.jit(lambda tb, ix: (tb[0, ix], tb[1, ix]))
+    timed("gather 2x 2^23 random from 2^27", g2, tab2, idx)
+
+
+CASES = {"sorts": case_sorts, "big": case_big, "gather": case_gather}
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["all"]
+    print(f"device {jax.devices()[0]}", flush=True)
+    for name, fn in CASES.items():
+        if "all" in which or name in which:
+            fn()

@@ -67,7 +67,7 @@ def main():
     ak = tuple(
         jnp.full((ck.ACAP,), SENTINEL, jnp.uint32) for _ in range(K)
     )
-    arows = z((ck.ACAP * ck.W,), jnp.uint32)
+    arows = z((ck.W, ck.ACAP), jnp.uint32)
     rows_store = z((ck.LCAP * ck.W,), jnp.uint32)
     vk = tuple(
         jnp.full((ck.VCAP,), SENTINEL, jnp.uint32) for _ in range(K)

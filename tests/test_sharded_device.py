@@ -82,3 +82,133 @@ def test_sharded_device_truncation():
         visited_cap=1 << 10, max_states=64,
     ).run()
     assert r.truncated
+
+
+def test_sharded_device_checkpoint_resume_exact_count(tmp_path):
+    """Truncate-and-resume on an 8-shard mesh must reach the published
+    45,198 / diameter-20 oracle exactly (VERDICT r3 #6): run with a
+    tiny max_states to force truncation, then resume (repeatedly, to
+    cross several checkpoints) until complete."""
+    ckpt = str(tmp_path / "sd.npz")
+
+    def make(max_states):
+        ck = ShardedDeviceChecker(
+            CompactionModel(pe.SHIPPED_CFG), n_devices=8, sub_batch=512,
+            visited_cap=1 << 13, max_states=max_states,
+            checkpoint_path=ckpt, checkpoint_every=2,
+        )
+        return ck
+
+    r = make(2_000).run()
+    assert r.truncated
+    r = make(20_000).run(resume=True)
+    assert r.truncated
+    r = make(1 << 26).run(resume=True)
+    assert not r.truncated
+    assert r.distinct_states == 45198
+    assert r.diameter == 20
+    assert r.violation is None and not r.deadlock
+
+
+def test_sharded_device_resume_rejects_other_config(tmp_path):
+    ckpt = str(tmp_path / "sd.npz")
+    ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=4, sub_batch=512,
+        visited_cap=1 << 13, max_states=2_000, checkpoint_path=ckpt,
+    ).run()
+    other = ShardedDeviceChecker(
+        CompactionModel(SMALL_CONFIGS["producer_on"]), n_devices=4,
+        sub_batch=128, visited_cap=1 << 10, checkpoint_path=ckpt,
+    )
+    with pytest.raises(ValueError, match="different configuration"):
+        other.run(resume=True)
+
+
+def test_sharded_device_trace_spans_resume(tmp_path):
+    """A counterexample found after a resume must replay across the
+    checkpoint boundary (parent chain lives in the restored logs)."""
+    ckpt = str(tmp_path / "sd.npz")
+    r = ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=4,
+        invariants=("CompactedLedgerLeak",), sub_batch=512,
+        visited_cap=1 << 13, max_states=9_000,
+        checkpoint_path=ckpt, checkpoint_every=1,
+    ).run()
+    assert r.truncated and r.violation is None
+    r = ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=4,
+        invariants=("CompactedLedgerLeak",), sub_batch=512,
+        visited_cap=1 << 13, checkpoint_path=ckpt,
+    ).run(resume=True)
+    assert r.violation == "CompactedLedgerLeak"
+    assert r.diameter == 12
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, r.trace, r.trace_actions, "CompactedLedgerLeak"
+    )
+
+
+def test_sharded_device_route_overflow_autorecovers():
+    """A deliberately starved route capacity (route_slack << 1) must
+    auto-recover (double slack, re-jit, retry the level) and still
+    reach the oracle count exactly (VERDICT r3 #8)."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    ck = ShardedDeviceChecker(
+        CompactionModel(c), n_devices=4, invariants=(), sub_batch=128,
+        visited_cap=1 << 10, route_slack=0.03,
+    )
+    got = ck.run()
+    assert ck.route_slack > 0.03  # recovery actually fired
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+
+
+@pytest.mark.parametrize("slices,per", [(2, 4), (4, 2)])
+def test_sharded_device_2d_mesh_counts_identical(slices, per):
+    """Hierarchical dcn x ici routing (owner-slice over dcn, then
+    owner-chip over ici) inside the jitted round step must reproduce
+    the oracle exactly on a 2-D virtual mesh (VERDICT r3 #7)."""
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    got = ShardedDeviceChecker(
+        CompactionModel(c), n_devices=slices * per, n_slices=slices,
+        invariants=(), sub_batch=128, visited_cap=1 << 10,
+    ).run()
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.violation is None and not got.deadlock
+
+
+def test_sharded_device_2d_shipped_cfg_published_count():
+    got = ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=8, n_slices=2,
+        sub_batch=512, visited_cap=1 << 13,
+    ).run()
+    assert got.distinct_states == 45198
+    assert got.diameter == 20
+
+
+def test_sharded_device_2d_counterexample_replays():
+    got = ShardedDeviceChecker(
+        CompactionModel(pe.SHIPPED_CFG), n_devices=8, n_slices=4,
+        invariants=("DuplicateNullKeyMessage",), sub_batch=512,
+        visited_cap=1 << 13,
+    ).run()
+    assert got.violation == "DuplicateNullKeyMessage"
+    assert_valid_counterexample(
+        pe.SHIPPED_CFG, got.trace, got.trace_actions,
+        "DuplicateNullKeyMessage",
+    )
+
+
+def test_sharded_device_2d_route_overflow_autorecovers():
+    c = SMALL_CONFIGS["producer_on"]
+    want = pe.check(c, invariants=())
+    ck = ShardedDeviceChecker(
+        CompactionModel(c), n_devices=8, n_slices=2, invariants=(),
+        sub_batch=128, visited_cap=1 << 10, route_slack=0.03,
+    )
+    got = ck.run()
+    assert ck.route_slack > 0.03
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
