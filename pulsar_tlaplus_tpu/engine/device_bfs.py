@@ -95,6 +95,7 @@ class DeviceChecker:
         flush_factor: int = 1,
         fp_bits: Optional[int] = None,
         append_chunk: Optional[int] = None,
+        seed_cap: Optional[int] = None,
     ):
         self.model = model
         self.layout = model.layout
@@ -175,6 +176,13 @@ class DeviceChecker:
         self.progress = progress
         self.metrics_path = metrics_path
         self.group = group
+        if seed_cap is not None:
+            # sorted-column capacity of the host-seed merge path; a
+            # bench-scale warm start (VERDICT r3: the first ~10 s of
+            # the round-3 run produced 0.6M of its 32M states because
+            # tiny early levels pay full-width sort latency) needs a
+            # bigger tier than the 2^16 default
+            self.SEED_VCAP = self._round_cap(seed_cap)
         self._jits: Dict[tuple, object] = {}
         self.last_stats: Dict[str, float] = {}
 
@@ -390,19 +398,25 @@ class DeviceChecker:
     # full-ACAP unpack is multi-GB at bench shapes)
     SL = 1 << 17
 
-    def _append_core_jit(self, is_init: bool):
-        """Collect the flush's new states WITHOUT any gather: a stable
-        value-carrying sort on the acc-order new-flag compacts the W
-        word columns (plus the slot iota for parent/lane derivation) to
-        the front in discovery order.  Gathers are latency-bound per
-        element on TPU (~50 ns — a gather-based append measured 10.9 s
-        per 8.9M lanes, profile_stages.py); this sort costs
-        ``(W+2) * ACAP`` bandwidth-bound sort traffic instead.
+    def _append_jit(self):
+        """Collect the flush's new states WITHOUT any gather: the
+        acc-order new-flag compacts the W word columns to the front in
+        discovery order via ``dedup.compact_by_flag`` — chunked
+        single-key unstable sorts with the slot iota embedded in the
+        key (round 4: the round-3 monolithic 22-operand stable sort
+        here was 84% of the 886 s warmup; see compact_by_flag).
+        Gathers are latency-bound per element on TPU (~17-50 ns — a
+        gather-based append measured 10.9 s per 8.9M lanes,
+        profile_stages.py), so sorts it is.
+
+        ``is_init`` rides as a traced flag (one compile, not two):
+        roots log ``-1 - init_idx`` parents, expand lanes log
+        ``(parent gid, action lane)``.
 
         Invariants then evaluate on exactly the new states (deduped —
         round 2 paid this on every candidate lane) in SL-sized scan
         chunks of the compacted columns."""
-        key = ("appcore", is_init)
+        key = ("append", self.LCAP)
         if key in self._jits:
             return self._jits[key]
         A, W, ACAP = self.A, self.W, self.ACAP
@@ -411,89 +425,77 @@ class DeviceChecker:
         inv_fns = [self.model.invariants[n] for n in self.invariant_names]
         n_inv = len(self.invariant_names)
 
-        def step(arows, flag_acc, n_new, n_visited, viol, acc_base):
-            drop = (flag_acc ^ jnp.uint32(1)).astype(jnp.uint32)
+        def step(rows_store, parent_log, lane_log, arows, flag_acc,
+                 n_new, n_visited, viol, acc_base, is_init):
+            drop = flag_acc ^ jnp.uint32(1)
             cols = tuple(arows[j] for j in range(W))
-            iota = jnp.arange(ACAP, dtype=jnp.uint32)
-            out = lax.sort(
-                (drop, *cols, iota), num_keys=1, is_stable=True
-            )
-            ccols, ciota = out[1: W + 1], out[W + 1]
-            idx = ciota.astype(jnp.int32)
+            ccols, idx = dedup.compact_by_flag(drop, cols)
             lanei = jnp.arange(ACAP, dtype=jnp.int32)
             live = lanei < n_new
-            if is_init:
-                par = -1 - (acc_base + idx)
-                lane = jnp.zeros((ACAP,), jnp.int32)
-            else:
-                par = acc_base + idx // A
-                lane = idx % A
+            par = jnp.where(
+                is_init, -1 - (acc_base + idx), acc_base + idx // A
+            )
+            lane = jnp.where(is_init, 0, idx % A)
             par = jnp.where(live, par, 0)
             lane = jnp.where(live, lane, 0)
-            if n_inv:
-                # pad so the eval chunks can never clamp mid-window
-                pad = C * SL - ACAP
-                ecols = (
-                    tuple(
-                        jnp.concatenate(
-                            [c, jnp.zeros((pad,), jnp.uint32)]
-                        )
-                        for c in ccols
+            # pad so the chunks can never clamp mid-window
+            pad = C * SL - ACAP
+            ecols = (
+                tuple(
+                    jnp.concatenate(
+                        [c, jnp.zeros((pad,), jnp.uint32)]
                     )
-                    if pad
-                    else ccols
+                    for c in ccols
                 )
+                if pad
+                else ccols
+            )
 
-                def chunk(viol, c):
-                    off = c * SL
-                    rows = jnp.stack(
-                        [
-                            lax.dynamic_slice(col, (off,), (SL,))
-                            for col in ecols
-                        ],
-                        axis=1,
-                    )
+            # one SL-chunked scan does BOTH invariant evaluation and
+            # the row-store append: each chunk interleaves its [SL, W]
+            # rows (needed for the unpack anyway) and lands them with a
+            # blind DUS at [n_visited + off, ...).  Writing the store
+            # chunk-wise keeps every intermediate SL-sized — a
+            # monolithic [ACAP, W] stack takes the 128-padded T(8,128)
+            # tiled layout on TPU (6.4x memory = 9.1 GB at the ff=2
+            # bench tier; it OOMed the XLA memory planner).  The tail
+            # beyond n_new is garbage the NEXT flush's window
+            # overwrites before it can ever be read (reads only touch
+            # [0, n_visited)); the run loop guarantees ``n_visited +
+            # APAD <= LCAP`` before dispatching, so no DUS can clamp.
+            def chunk(carry, c):
+                viol, store = carry
+                off = c * SL
+                rows = jnp.stack(
+                    [
+                        lax.dynamic_slice(col, (off,), (SL,))
+                        for col in ecols
+                    ],
+                    axis=1,
+                )
+                if n_inv:
                     gids = n_visited + off + jnp.arange(
                         SL, dtype=jnp.int32
                     )
-                    livec = off + jnp.arange(SL, dtype=jnp.int32) < n_new
+                    livec = (
+                        off + jnp.arange(SL, dtype=jnp.int32) < n_new
+                    )
                     states = jax.vmap(layout.unpack)(rows)
                     vnew = []
                     for fn in inv_fns:
                         ok = jax.vmap(fn)(states)
                         bad = livec & ~ok
                         vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
-                    return jnp.minimum(viol, jnp.stack(vnew)), None
-
-                viol, _ = lax.scan(
-                    chunk, viol, jnp.arange(C, dtype=jnp.int32)
+                    viol = jnp.minimum(viol, jnp.stack(vnew))
+                store = lax.dynamic_update_slice(
+                    store, rows.reshape(SL * W),
+                    ((n_visited + off) * W,),
                 )
-            rows_flat = jnp.stack(ccols, axis=1).reshape(ACAP * W)
-            return rows_flat, par, lane, n_visited + n_new, viol
+                return (viol, store), None
 
-        fn = jax.jit(step)
-        self._jits[key] = fn
-        return fn
-
-    def _append_write_jit(self):
-        """Blind DUS writer: append the collected [APAD, W] rows and
-        parent/lane columns at [n_visited, n_visited + APAD).  The tail
-        beyond n_new is garbage that the NEXT flush's window overwrites
-        before it can ever be read (reads only touch [0, n_visited));
-        the run loop guarantees ``n_visited + APAD <= LCAP`` before
-        dispatching, so no DUS can clamp.  DUS-only on purpose: a
-        gather in this computation would force the multi-GB row store
-        into the 128-padded tiled layout."""
-        key = ("appwrite", self.LCAP)
-        if key in self._jits:
-            return self._jits[key]
-
-        W = self.W
-
-        def step(rows_store, parent_log, lane_log, rows, par, lane,
-                 n_visited):
-            rows_store = lax.dynamic_update_slice(
-                rows_store, rows, (n_visited * W,)
+            (viol, rows_store), _ = lax.scan(
+                chunk, (viol, rows_store),
+                jnp.arange(C, dtype=jnp.int32),
             )
             parent_log = lax.dynamic_update_slice(
                 parent_log, par, (n_visited,)
@@ -501,7 +503,10 @@ class DeviceChecker:
             lane_log = lax.dynamic_update_slice(
                 lane_log, lane, (n_visited,)
             )
-            return rows_store, parent_log, lane_log
+            return (
+                rows_store, parent_log, lane_log, n_visited + n_new,
+                viol,
+            )
 
         fn = jax.jit(step, donate_argnums=(0, 1, 2))
         self._jits[key] = fn
@@ -780,27 +785,17 @@ class DeviceChecker:
         mark("flush")
         del vk
         flag_w = out[K + 1]
+        del out
         viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
-        for is_init in (True, False):
-            app = self._append_core_jit(is_init)(
-                arows, flag_w, jnp.int32(0), jnp.int32(0), viol0,
-                jnp.int32(0),
-            )
-            drain(app)
-            mark("appcore_init" if is_init else "appcore")
-            if is_init:
-                del app  # both app tuples alive at once would be ~3 GB
-        rows_w, par_w, lane_w = app[0], app[1], app[2]
-        del app
-        drain(
-            self._append_write_jit()(
-                z((self.LCAP * self.W,), jnp.uint32),
-                z((self.LCAP,), jnp.int32), z((self.LCAP,), jnp.int32),
-                rows_w, par_w, lane_w, jnp.int32(0),
-            )
+        app = self._append_jit()(
+            z((self.LCAP * self.W,), jnp.uint32),
+            z((self.LCAP,), jnp.int32), z((self.LCAP,), jnp.int32),
+            arows, flag_w, jnp.int32(0), jnp.int32(0), viol0,
+            jnp.int32(0), jnp.bool_(False),
         )
-        mark("appwrite")
-        del ak, arows, flag_w, rows_w, par_w, lane_w
+        drain(app)
+        mark("append")
+        del app, ak, arows, flag_w
         drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
         drain(
             self._chain_jit(4)(
@@ -887,20 +882,14 @@ class DeviceChecker:
             )
             bufs["vk"] = out[:K]
             n_new, flag_acc = out[K], out[K + 1]
-            rows, par, lane, n_vis2, viol2 = self._append_core_jit(
-                is_init
-            )(
+            (
+                bufs["rows"], bufs["parent"], bufs["lane"],
+                st["n_visited"], st["viol"],
+            ) = self._append_jit()(
+                bufs["rows"], bufs["parent"], bufs["lane"],
                 bufs["arows"], flag_acc, n_new, st["n_visited"],
-                st["viol"], jnp.int32(acc_base),
+                st["viol"], jnp.int32(acc_base), jnp.bool_(is_init),
             )
-            bufs["rows"], bufs["parent"], bufs["lane"] = (
-                self._append_write_jit()(
-                    bufs["rows"], bufs["parent"], bufs["lane"],
-                    rows, par, lane, st["n_visited"],
-                )
-            )
-            st["n_visited"] = n_vis2
-            st["viol"] = viol2
 
         if seed is not None:
             level_sizes = self._load_seed(bufs, st, seed)

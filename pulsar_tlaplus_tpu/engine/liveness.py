@@ -2,9 +2,25 @@
 reachable state graph, e.g. ``Termination`` (compaction.tla:303-307).
 
 TPU/host split (SURVEY.md §7-L6): the TPU generates the behavior graph —
-the exhaustive BFS plus one vectorized edge-materialization sweep over all
-discovered states — and the irregular graph analysis (reachability under
-the not-goal restriction, Kahn-peeling cycle detection) runs on the host.
+the exhaustive BFS plus a vectorized edge-materialization sweep over all
+discovered states — and the graph analysis (reachability under the
+not-goal restriction, Kahn-peeling cycle detection) runs on the host as
+vectorized numpy level sweeps.
+
+Round-4 scaling (VERDICT r3 #5): the round-3 sweep round-tripped every
+successor key through host ``np.searchsorted`` per 2048-state chunk —
+fine at 253k states, hopeless at millions behind the 130 ms / 20 MB/s
+tunnel.  Now the whole gid lookup runs on device against the engine's
+own HBM-resident row store:
+
+- a key->gid table is built once: state keys (straight from the packed
+  rows, no unpack) sorted with their gid as payload;
+- each sweep chunk expands successors, makes their keys, and joins them
+  against the table with ONE merged sort + a log-shift gid propagation
+  through equal-key runs — no gathers (latency-bound on TPU), no host
+  in the loop;
+- only the final int32 dst-gid lanes stream to the host (the edge list
+  the analysis needs), plus one bool per state for the goal predicate.
 
 Semantics (matching the oracle, pyeval.check_eventually):
 
@@ -28,8 +44,12 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+
+TAG = jnp.uint32(1 << 31)
 
 
 @dataclass
@@ -71,7 +91,7 @@ class LivenessChecker:
         # exploration runs on the device-resident engine (VERDICT r2
         # #8: the round-2 host-staged explorer capped liveness at small
         # state spaces); its append-only row store IS the packed state
-        # matrix, streamed to the host once for the edge sweep
+        # matrix — it never leaves HBM
         self._checker = DeviceChecker(
             model,
             invariants=(),
@@ -81,8 +101,9 @@ class LivenessChecker:
             frontier_cap=visited_cap,
             max_states=max_states,
         )
-        self._explored = None  # (packed, n, n_init) — shared across goals
+        self._explored = None  # (n, n_init) — rows stay on device
         self._edge_cache = None  # (src, dst, out_deg) — goal-independent
+        self._jits = {}
 
     def _explore(self):
         """One exhaustive BFS, cached so several properties (cfg
@@ -102,11 +123,7 @@ class LivenessChecker:
                 f"({res.violation}); liveness requires the full state "
                 "graph — fix the safety violation first"
             )
-        n = res.distinct_states
-        W = self.model.layout.W
-        rows = self._checker.last_bufs["rows"]
-        packed = np.asarray(rows[: n * W]).reshape(n, W)
-        self._explored = (packed, n, res.level_sizes[0])
+        self._explored = (res.distinct_states, res.level_sizes[0])
         return self._explored
 
     def run_goal(self, goal: str) -> LivenessResult:
@@ -117,84 +134,219 @@ class LivenessChecker:
         self.goal_fn = goals[goal]
         return self.run()
 
-    def _edges(self, packed, n):
-        """Goal-independent <Next>_vars edge list.  Device sweep computes
-        each state's successor dedup KEYS (12B/edge, not full packed
-        states); gid lookup is one vectorized searchsorted over the
-        sorted key table — no per-(state, lane) Python loop (the round-1
-        bottleneck)."""
-        if self._edge_cache is not None:
-            return self._edge_cache
-        m = self.model
-        layout = m.layout
+    # ------------------------------------------------------ device jits
+
+    def _keys_of_rows(self, rows_flat, cap):
+        """Key columns of the first ``cap`` packed rows (no unpack)."""
         from pulsar_tlaplus_tpu.ops import dedup as dedup_ops
 
-        def _one(w):
-            s = layout.unpack(w)
-            succ, valid = m.successors(s)
-            sp = jax.vmap(layout.pack)(succ)
-            k1, k2, k3 = dedup_ops.make_keys(sp, layout.total_bits)
-            return jnp.stack([k1, k2, k3], axis=-1), valid
-
-        succ_fn = jax.jit(jax.vmap(_one))
-
-        def _void(keys3: np.ndarray) -> np.ndarray:
-            """[n, 3] u32 -> void12 rows (memcmp order; consistent on
-            both sides of the searchsorted)."""
-            a = np.ascontiguousarray(keys3.astype(np.uint32))
-            return a.view([("v", "V12")]).ravel()
-
-        k1, k2, k3 = (
-            np.asarray(x)
-            for x in dedup_ops.make_keys(
-                jnp.asarray(packed), layout.total_bits
-            )
+        W = self.model.layout.W
+        packed = lax.dynamic_slice(rows_flat, (0,), (cap * W,)).reshape(
+            cap, W
         )
-        state_keys = _void(np.stack([k1, k2, k3], axis=-1))
-        order = np.argsort(state_keys)
-        sorted_keys = state_keys[order]
-        src_parts, dst_parts = [], []
-        for start in range(0, n, self.F):
-            chunk = packed[start : start + self.F]
-            nc = len(chunk)
-            if nc < self.F:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((self.F - nc, layout.W), np.uint32)]
+        return dedup_ops.make_keys(packed, self.model.layout.total_bits)
+
+    def _table_jit(self, cap):
+        """rows_flat, n -> sorted (k1, k2, k3, gid) key->gid table of
+        static width ``cap`` (SENTINEL-padded past n)."""
+        key = ("table", cap)
+        if key in self._jits:
+            return self._jits[key]
+
+        def step(rows_flat, n):
+            k1, k2, k3 = self._keys_of_rows(rows_flat, cap)
+            live = jnp.arange(cap, dtype=jnp.int32) < n
+            k1 = jnp.where(live, k1, SENTINEL)
+            k2 = jnp.where(live, k2, SENTINEL)
+            k3 = jnp.where(live, k3, SENTINEL)
+            gid = jnp.arange(cap, dtype=jnp.uint32)
+            return lax.sort((k1, k2, k3, gid), num_keys=3,
+                            is_stable=False)
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _goal_jit(self, cap):
+        """rows_flat, n -> bool[cap] goal-predicate values."""
+        key = ("goal", cap, self.goal_fn)
+        if key in self._jits:
+            return self._jits[key]
+        layout = self.model.layout
+        W = layout.W
+        F = self.F
+
+        def step(rows_flat, n):
+            def chunk(c, _):
+                rows = lax.dynamic_slice(
+                    rows_flat, (c * F * W,), (F * W,)
+                ).reshape(F, W)
+                g = jax.vmap(
+                    lambda w: self.goal_fn(layout.unpack(w))
+                )(rows)
+                return c + 1, g
+
+            _, gs = lax.scan(
+                chunk, jnp.int32(0), None, length=cap // F
+            )
+            return gs.reshape(cap)
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _sweep_jit(self, cap):
+        """(rows_flat, off, n_live, table cols) -> dst gid per
+        successor lane of the F-state window at ``off``: ``dst[i*A+l]``
+        = gid of state i's lane-l successor, or -1 when the lane is
+        invalid.  Self-loops resolve to the state's own gid (the host
+        drops them as stutters).
+
+        The join is one merged sort of (table, query keys) with the
+        table's gid as payload (table entries order before equal-key
+        queries via the payload tag bit), then a log-shift propagation
+        of the gid through equal-key runs — sort + elementwise shifts
+        only, no gathers."""
+        key = ("sweep", cap)
+        if key in self._jits:
+            return self._jits[key]
+        m, layout = self.model, self.model.layout
+        W, A, F = layout.W, self.model.A, self.F
+        from pulsar_tlaplus_tpu.ops import dedup as dedup_ops
+
+        NQ = F * A
+
+        def step(rows_flat, off, n_live, t1, t2, t3, tg):
+            rows = lax.dynamic_slice(
+                rows_flat, (off * W,), (F * W,)
+            ).reshape(F, W)
+            states = jax.vmap(layout.unpack)(rows)
+            succ, valid = jax.vmap(m.successors)(states)
+            live = off + jnp.arange(F, dtype=jnp.int32) < n_live
+            valid = valid & live[:, None]
+            sp = jax.vmap(jax.vmap(layout.pack))(succ).reshape(NQ, W)
+            q1, q2, q3 = dedup_ops.make_keys(sp, layout.total_bits)
+            vq = valid.reshape(NQ)
+            q1 = jnp.where(vq, q1, SENTINEL)
+            q2 = jnp.where(vq, q2, SENTINEL)
+            q3 = jnp.where(vq, q3, SENTINEL)
+            qpay = jnp.arange(NQ, dtype=jnp.uint32) | TAG
+            c1 = jnp.concatenate([t1, q1])
+            c2 = jnp.concatenate([t2, q2])
+            c3 = jnp.concatenate([t3, q3])
+            pay = jnp.concatenate([tg, qpay])
+            s1, s2, s3, sp_ = lax.sort(
+                (c1, c2, c3, pay), num_keys=4, is_stable=False
+            )
+            # carried gid: table rows expose their gid; query rows start
+            # unknown (-1) and take it from the nearest preceding
+            # equal-key row via log-shift propagation
+            is_q = (sp_ & TAG) != 0
+            gid = jnp.where(is_q, -1, sp_.astype(jnp.int32))
+            # pointer-jumping: a run = 1 unique table entry + its
+            # equal-key queries, so the longest fill distance is NQ;
+            # doubling shifts cover it in ceil(log2 NQ)+1 rounds
+            d = 1
+            while d <= NQ:
+                # shift forward by d: rows [d:] see row [i-d]
+                pk1 = jnp.concatenate([jnp.full((d,), SENTINEL), s1[:-d]])
+                pk2 = jnp.concatenate([jnp.full((d,), SENTINEL), s2[:-d]])
+                pk3 = jnp.concatenate([jnp.full((d,), SENTINEL), s3[:-d]])
+                pg = jnp.concatenate(
+                    [jnp.full((d,), -1, jnp.int32), gid[:-d]]
                 )
-            sk, sv = succ_fn(jnp.asarray(chunk))
-            sk = np.asarray(sk)[:nc]  # [nc, A, 3]
-            sv = np.asarray(sv)[:nc]  # [nc, A]
-            flat = _void(sk.reshape(-1, 3))
-            pos = np.searchsorted(sorted_keys, flat)
-            pos = np.clip(pos, 0, n - 1)
-            v = order[pos]
-            ok = (sorted_keys[pos] == flat) & sv.reshape(-1)
-            u = np.repeat(np.arange(start, start + nc, dtype=np.int64), m.A)
-            keep_e = ok & (v != u)  # drop stutters: not <Next>_vars
-            src_parts.append(u[keep_e])
-            dst_parts.append(v[keep_e].astype(np.int64))
-        src = np.concatenate(src_parts) if src_parts else np.zeros(0, np.int64)
-        dst = np.concatenate(dst_parts) if dst_parts else np.zeros(0, np.int64)
+                same = (pk1 == s1) & (pk2 == s2) & (pk3 == s3)
+                gid = jnp.where((gid < 0) & same, pg, gid)
+                d <<= 1
+            # back to query order: payload sort; queries (TAG set) sort
+            # after every table gid and ascend by lane index
+            _, gq = lax.sort(
+                (sp_, lax.bitcast_convert_type(gid, jnp.uint32)),
+                num_keys=1, is_stable=False,
+            )
+            dst = lax.bitcast_convert_type(gq[cap:], jnp.int32)
+            # -1 = invalid lane; -2 = VALID lane with no table match,
+            # i.e. a successor outside the visited set — exploration
+            # was incomplete and the host must fail loudly rather than
+            # silently dropping the edge
+            return jnp.where(vq, jnp.where(dst < 0, -2, dst), -1)
+
+        fn = jax.jit(step)
+        self._jits[key] = fn
+        return fn
+
+    # ----------------------------------------------------- edge harvest
+
+    def _edges(self, n):
+        """Goal-independent <Next>_vars edge list (CSR-ready numpy
+        int32 arrays) + out-degree per state."""
+        if self._edge_cache is not None:
+            return self._edge_cache
+        A, W = self.model.A, self.model.layout.W
+        rows = self._checker.last_bufs["rows"]
+        cap = self._table_cap(n)
+        t1, t2, t3, tg = self._table_jit(cap)(rows, jnp.int32(n))
+        sweep = self._sweep_jit(cap)
+        F = self.F
+        src_parts, dst_parts = [], []
         out_deg = np.zeros((n,), np.int64)
-        np.add.at(out_deg, src, 1)
+        starts = list(range(0, n, F))
+        # double-buffer: dispatch chunk k+1 before materializing chunk
+        # k, so device compute overlaps the ~130 ms / 20 MB/s tunnel
+        # readback (chunks are independent)
+        pending = []
+        for start in starts[:1]:
+            pending.append(
+                sweep(rows, jnp.int32(start), jnp.int32(n), t1, t2,
+                      t3, tg)
+            )
+        for i, start in enumerate(starts):
+            if i + 1 < len(starts):
+                pending.append(
+                    sweep(
+                        rows, jnp.int32(starts[i + 1]), jnp.int32(n),
+                        t1, t2, t3, tg,
+                    )
+                )
+            dst = np.asarray(pending.pop(0))
+            u = np.repeat(
+                np.arange(start, start + F, dtype=np.int64), A
+            )
+            if (dst == -2).any():
+                raise RuntimeError(
+                    "edge sweep found a successor outside the visited "
+                    "set — BFS exploration was incomplete"
+                )
+            keep = (dst >= 0) & (dst != u)  # drop stutters + invalid
+            uu = u[keep]
+            vv = dst[keep].astype(np.int64)
+            src_parts.append(uu)
+            dst_parts.append(vv)
+            np.add.at(out_deg, uu, 1)
+        src = (
+            np.concatenate(src_parts) if src_parts
+            else np.zeros(0, np.int64)
+        )
+        dst = (
+            np.concatenate(dst_parts) if dst_parts
+            else np.zeros(0, np.int64)
+        )
         self._edge_cache = (src, dst, out_deg)
         return self._edge_cache
 
-    def run(self) -> LivenessResult:
-        m = self.model
-        layout = m.layout
-        packed, n, n_init = self._explore()
+    def _table_cap(self, n: int) -> int:
+        cap = self.F  # multiple of the goal/sweep chunk
+        while cap < n:
+            cap += self.F
+        return cap
 
-        goal_fn = jax.jit(jax.vmap(lambda w: self.goal_fn(layout.unpack(w))))
-        goal = np.zeros((n,), bool)
-        for start in range(0, n, self.F):
-            chunk = packed[start : start + self.F]
-            nc = len(chunk)
-            if nc < self.F:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((self.F - nc, layout.W), np.uint32)]
-                )
-            goal[start : start + nc] = np.asarray(goal_fn(jnp.asarray(chunk)))[:nc]
+    # -------------------------------------------------------------- run
+
+    def run(self) -> LivenessResult:
+        n, n_init = self._explore()
+        cap = self._table_cap(n)
+        rows = self._checker.last_bufs["rows"]
+        goal = np.asarray(self._goal_jit(cap)(rows, jnp.int32(n)))[:n]
 
         if self.fairness == "none":
             bad = np.nonzero(~goal[:n_init])[0]
@@ -213,29 +365,44 @@ class LivenessChecker:
             )
 
         # ---- wf_next: materialize the edge list (cached across goals) ----
-        src, dst, out_deg = self._edges(packed, n)
+        src, dst, out_deg = self._edges(n)
 
-
-        # restrict to not-goal -> not-goal edges; reach R from not-goal inits
+        # restrict to not-goal -> not-goal edges; CSR over sources
         keep = ~goal[src] & ~goal[dst]
         rsrc, rdst = src[keep], dst[keep]
         order_adj = np.argsort(rsrc, kind="stable")
         rsrc, rdst = rsrc[order_adj], rdst[order_adj]
         starts = np.searchsorted(rsrc, np.arange(n + 1))
+
+        # reach R from not-goal initial states: vectorized BFS sweeps
+        # (the round-3 python-loop DFS was the scale limit)
         in_r = np.zeros((n,), bool)
-        stack = [int(i) for i in np.nonzero(~goal[:n_init])[0]]
         parent = np.full((n,), -1, np.int64)
-        while stack:
-            u = stack.pop()
-            if in_r[u]:
-                continue
-            in_r[u] = True
-            for v in rdst[starts[u] : starts[u + 1]]:
-                v = int(v)
-                if not in_r[v]:
-                    if parent[v] < 0:
-                        parent[v] = u
-                    stack.append(v)
+        frontier = np.nonzero(~goal[:n_init])[0]
+        in_r[frontier] = True
+        while len(frontier):
+            # all out-edges of the frontier, via CSR ranges
+            cnt = starts[frontier + 1] - starts[frontier]
+            total = int(cnt.sum())
+            if total == 0:
+                break
+            base = np.repeat(starts[frontier], cnt)
+            offs = np.arange(total) - np.repeat(
+                np.cumsum(cnt) - cnt, cnt
+            )
+            eidx = base + offs
+            vs = rdst[eidx]
+            us = rsrc[eidx]
+            fresh = ~in_r[vs]
+            if not fresh.any():
+                break
+            vf = vs[fresh]
+            uf = us[fresh]
+            # first writer wins is irrelevant — any parent is a valid
+            # predecessor for the lasso prefix
+            parent[vf] = uf
+            in_r[vf] = True
+            frontier = np.unique(vf)
         r_nodes = np.nonzero(in_r)[0]
         if len(r_nodes) == 0:
             return LivenessResult(
@@ -252,26 +419,32 @@ class LivenessChecker:
                 lasso_prefix=self._path_to(parent, g, n_init),
                 lasso_cycle=[g],
             )
-        # Kahn peel within R
+        # Kahn peel within R — wave-vectorized
         indeg = np.zeros((n,), np.int64)
         both = in_r[rsrc] & in_r[rdst]
         np.add.at(indeg, rdst[both], 1)
-        queue = [int(u) for u in r_nodes if indeg[u] == 0]
         alive = in_r.copy()
-        while queue:
-            u = queue.pop()
-            alive[u] = False
-            for v in rdst[starts[u] : starts[u + 1]]:
-                v = int(v)
-                if alive[v]:
-                    indeg[v] -= 1
-                    if indeg[v] == 0:
-                        queue.append(v)
+        wave = r_nodes[indeg[r_nodes] == 0]
+        while len(wave):
+            alive[wave] = False
+            cnt = starts[wave + 1] - starts[wave]
+            total = int(cnt.sum())
+            if total == 0:
+                break
+            base = np.repeat(starts[wave], cnt)
+            offs = np.arange(total) - np.repeat(
+                np.cumsum(cnt) - cnt, cnt
+            )
+            vs = rdst[base + offs]
+            am = alive[vs]
+            np.subtract.at(indeg, vs[am], 1)
+            cand = np.unique(vs[am])
+            wave = cand[(indeg[cand] == 0) & alive[cand]]
         cyc_nodes = np.nonzero(alive)[0]
         if len(cyc_nodes):
             # Kahn peeling (in-degree) can leave acyclic tail nodes that
             # dangle off a cycle; one backward Kahn pass on OUT-degree
-            # (linear, via the reverse adjacency) removes them so every
+            # (via the reverse adjacency) removes them so every
             # surviving node has an alive successor and the
             # cycle-recovery walk is total.
             both = alive[rsrc] & alive[rdst]
@@ -280,16 +453,22 @@ class LivenessChecker:
             rorder = np.argsort(rdst, kind="stable")
             bsrc, bdst = rsrc[rorder], rdst[rorder]
             bstarts = np.searchsorted(bdst, np.arange(n + 1))
-            queue = [int(u) for u in cyc_nodes if odeg[u] == 0]
-            while queue:
-                u = queue.pop()
-                alive[u] = False
-                for p in bsrc[bstarts[u] : bstarts[u + 1]]:
-                    p = int(p)
-                    if alive[p]:
-                        odeg[p] -= 1
-                        if odeg[p] == 0:
-                            queue.append(p)
+            wave = cyc_nodes[odeg[cyc_nodes] == 0]
+            while len(wave):
+                alive[wave] = False
+                cnt = bstarts[wave + 1] - bstarts[wave]
+                total = int(cnt.sum())
+                if total == 0:
+                    break
+                base = np.repeat(bstarts[wave], cnt)
+                offs = np.arange(total) - np.repeat(
+                    np.cumsum(cnt) - cnt, cnt
+                )
+                ps = bsrc[base + offs]
+                am = alive[ps]
+                np.subtract.at(odeg, ps[am], 1)
+                cand = np.unique(ps[am])
+                wave = cand[(odeg[cand] == 0) & alive[cand]]
             cyc_nodes = np.nonzero(alive)[0]
         if len(cyc_nodes):
             # recover one cycle: walk alive-successors until a repeat
@@ -301,11 +480,11 @@ class LivenessChecker:
                 walk.append(u)
                 nxt = [
                     int(v)
-                    for v in rdst[starts[u] : starts[u + 1]]
+                    for v in rdst[starts[u]: starts[u + 1]]
                     if alive[v]
                 ]
                 u = nxt[0]
-            cycle = walk[seen_at[u] :]
+            cycle = walk[seen_at[u]:]
             return LivenessResult(
                 False,
                 "cycle of not-goal states is fairly traversable",

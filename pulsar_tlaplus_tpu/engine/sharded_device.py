@@ -653,19 +653,17 @@ class ShardedDeviceChecker:
             flag_acc, n_new = flag_acc[0], n_new[0]
             n_visited, viol = n_visited[0], viol[0]
             shard = self._shard_idx()
-            drop = (flag_acc ^ jnp.uint32(1)).astype(jnp.uint32)
-            cols = tuple(arows[j] for j in range(W))
-            out = lax.sort(
-                (
-                    drop, *cols,
-                    lax.bitcast_convert_type(apar, jnp.uint32),
-                    lax.bitcast_convert_type(alane, jnp.uint32),
-                ),
-                num_keys=1, is_stable=True,
+            drop = flag_acc ^ jnp.uint32(1)
+            cols = tuple(arows[j] for j in range(W)) + (
+                lax.bitcast_convert_type(apar, jnp.uint32),
+                lax.bitcast_convert_type(alane, jnp.uint32),
             )
-            ccols = out[1: W + 1]
-            par = lax.bitcast_convert_type(out[W + 1], jnp.int32)
-            lane = lax.bitcast_convert_type(out[W + 2], jnp.int32)
+            # chunked single-key compaction — the monolithic (W+3)-
+            # operand stable sort compiled ~5x slower (compact_by_flag)
+            out, _idx = dedup.compact_by_flag(drop, cols)
+            ccols = out[:W]
+            par = lax.bitcast_convert_type(out[W], jnp.int32)
+            lane = lax.bitcast_convert_type(out[W + 1], jnp.int32)
             lanei = jnp.arange(ACAP, dtype=jnp.int32)
             live = lanei < n_new
             par = jnp.where(live, par, 0)

@@ -510,6 +510,7 @@ class CompactionModel:
         frontier = list(states)
         while True:
             new = []
+            over = False
             for s in frontier:
                 sg = gid_of[s]
                 any_succ = False
@@ -527,9 +528,19 @@ class CompactionModel:
                         "deadlock state inside the seed prefix — check "
                         "without a seed"
                     )
+                if (
+                    len(new) > max_level_states
+                    or len(states) > max_total
+                ):
+                    # this level will be dropped anyway (seeds must be
+                    # level-complete): stop enumerating it NOW — fully
+                    # expanding an over-cap level costs minutes at
+                    # bench scale for states that get discarded
+                    over = True
+                    break
             if not new:
                 break
-            if len(new) > max_level_states or len(states) > max_total:
+            if over:
                 # the level that overflowed is dropped: seeds must be
                 # level-complete (partial levels would corrupt BFS depth)
                 for t in new:

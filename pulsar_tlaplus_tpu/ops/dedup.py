@@ -200,6 +200,42 @@ def merge_new_keys(vcols, ccols, cpay):
     return tuple(c[:V] for c in vout[1:]), n_new, sp, new_flag
 
 
+def compact_by_flag(drop, cols, chunk: int = 5):
+    """Stable-compact value columns to the front where ``drop == 0``
+    (original order preserved), without a wide multi-operand sort.
+
+    XLA sort COMPILE time explodes superlinearly in operand count on
+    the TPU tunnel backend (measured, scripts/profile_prims2.py: 2 ops
+    12 s, 6 ops 33 s, 21 ops 245 s, 21 stable 435 s — the round-3
+    append's 22-operand stable sort was 84% of the 886 s bench warmup)
+    while RUN time grows sublinearly.  So: ONE u32 key ``drop << 31 |
+    iota`` (all keys distinct, so an unstable single-key sort IS the
+    stable (drop, original-order) sort), applied in ``chunk``-column
+    value-carrying sorts.  ~4x faster compile at bench shapes for
+    ~25% more sort traffic.
+
+    Returns (compacted cols, idx) where ``idx[j]`` is the original row
+    of compacted position ``j`` (valid in the kept prefix).
+    """
+    n = drop.shape[0]
+    key = (drop.astype(jnp.uint32) << jnp.uint32(31)) | jnp.arange(
+        n, dtype=jnp.uint32
+    )
+    outs = []
+    idx = None
+    for i in range(0, len(cols), chunk):
+        res = jax.lax.sort(
+            (key, *cols[i: i + chunk]), num_keys=1, is_stable=False
+        )
+        if idx is None:
+            idx = (res[0] & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+        outs.extend(res[1:])
+    if idx is None:
+        srt = jax.lax.sort((key,), num_keys=1, is_stable=False)
+        idx = (srt[0] & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+    return tuple(outs), idx
+
+
 def _lex_less(
     a1: jax.Array, a2: jax.Array, a3: jax.Array,
     b1: jax.Array, b2: jax.Array, b3: jax.Array,

@@ -65,15 +65,12 @@ def main():
     barrier(fl[K], "flush")
     n_new, new_pay = fl[K], fl[K + 1]
     viol0 = jnp.full((len(ck.invariant_names),), int(BIG), jnp.int32)
-    core = ck._append_core_jit(True)(
-        bufs["arows"], new_pay, n_new, jnp.int32(0), viol0, jnp.int32(0)
-    )
-    barrier(core[3], "append_core")
-    wr = ck._append_write_jit()(
+    wr = ck._append_jit()(
         bufs["rows"], bufs["parent"], bufs["lane"],
-        core[0], core[1], core[2], jnp.int32(0),
+        bufs["arows"], new_pay, n_new, jnp.int32(0), viol0,
+        jnp.int32(0), jnp.bool_(True),
     )
-    barrier(wr[0], "append_write")
+    barrier(wr[3], "append")
     print("init phase complete", flush=True)
     # one expand round on the (single) frontier row
     out = ck._expand_jit()(

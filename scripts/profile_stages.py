@@ -122,15 +122,13 @@ def main():
 
     def do_append():
         nonlocal rows_store, par_log, lane_log
-        rows, par, lane, nv2, _v = ck._append_core_jit(False)(
-            arows, new_pay, n_new, jnp.int32(0), viol0, jnp.int32(0),
-        )
-        rows_store, par_log, lane_log = ck._append_write_jit()(
-            rows_store, par_log, lane_log, rows, par, lane, jnp.int32(0),
+        rows_store, par_log, lane_log, nv2, _v = ck._append_jit()(
+            rows_store, par_log, lane_log, arows, new_pay, n_new,
+            jnp.int32(0), viol0, jnp.int32(0), jnp.bool_(False),
         )
         return nv2
 
-    t_append = bench("append (gather+invariants+DUS)", do_append)
+    t_append = bench("append (compact+invariants+DUS)", do_append)
 
     per_flush = t_expand * flush_factor + t_flush + t_append
     print(

@@ -87,3 +87,25 @@ def test_simulation_clean_on_active_invariants():
     r = Simulator(m, n_walkers=256, depth=32, seed=0).run()
     assert r.violation is None
     assert r.states_visited == 256 * 33
+
+
+def test_liveness_wf_next_at_full_cfg_scale():
+    """VERDICT r3 #5: wf_next must materialize the full edge list at
+    the 253,361-state published-oracle scale — the round-3 scale test
+    used fairness="none", which never builds edges.  The device
+    merge-join sweep (key->gid table + one sort per chunk) makes this
+    tractable; the verdict must match the Python oracle's wf_next
+    semantics on the same config."""
+    c = dataclasses.replace(
+        pe.SHIPPED_CFG, model_producer=True, retain_null_key=False
+    )
+    got = LivenessChecker(
+        CompactionModel(c),
+        fairness="wf_next",
+        frontier_chunk=8192,
+        visited_cap=1 << 18,
+    ).run()
+    assert got.distinct_states == 253361
+    # the oracle's graph analysis at 253k states is slow but feasible
+    want_holds, _ = pe.check_eventually(c, "wf_next")
+    assert got.holds == want_holds
