@@ -39,7 +39,7 @@ import time
 
 BENCH_BUDGET_S = 150.0
 BASELINE_SLICE_S = 30.0
-MAX_STATES = 52_000_000
+MAX_STATES = 60_000_000
 
 # persistent XLA compilation cache: repeated bench runs skip compiles
 # (note: measured ineffective for the tunnel TPU backend — kept for the
@@ -133,13 +133,17 @@ def sustained_rates(metrics_path, wall_s):
     )
     final60 = None
     if wall_s >= 60.0:
-        cut = wall_s - 60.0
-        base = None
+        cut = last["wall_s"] - 60.0
+        # last record AT OR BEFORE the cut, so the window is >= 60 s
+        # (picking the first record after it could shrink the window
+        # to a single level and mislabel a burst as "final 60s")
+        base = recs[0]
         for r in recs:
-            if r["wall_s"] >= cut:
+            if r["wall_s"] <= cut:
                 base = r
+            else:
                 break
-        if base is not None and last["wall_s"] > base["wall_s"]:
+        if last["wall_s"] - base["wall_s"] >= 60.0:
             final60 = (
                 last["distinct_states"] - base["distinct_states"]
             ) / (last["wall_s"] - base["wall_s"])
@@ -192,47 +196,47 @@ def main():
         seed_cap=1 << 21,
     )
     t0 = time.time()
-    # warmup compiles run server-side over the tunnel; the host is
-    # idle, so measure the CPU baselines AND enumerate the warm-start
-    # seed concurrently instead of serially
+    # the host-seeded warm start: the round-3 run spent its first ~10 s
+    # producing 0.6M of its 32M states (tiny early levels pay
+    # full-width sort latency + tunnel RTTs); the Python oracle
+    # enumerates those levels (~55 s at this state width) while the TPU
+    # compiles — it contends a little with the local compile helper,
+    # but hides entirely inside the ~7-minute warmup
     import threading
 
-    base = {}
+    box = {}
 
-    def _baselines():
-        # the host-seeded warm start: the round-3 run spent its first
-        # ~10 s producing 0.6M of its 32M states (tiny early levels pay
-        # full-width sort latency + tunnel RTTs); the Python oracle
-        # enumerates those levels in ~2 s while the TPU compiles
-        base["seed"] = model.host_seed(
-            max_level_states=800_000, max_total=1_000_000
-        )
-        base["native"] = measure_native_baseline(c, threads=1)
-        base["native8"] = measure_native_baseline(c, threads=8)
-        base["py"] = measure_python_baseline(c, BASELINE_SLICE_S)
-
-    def _baselines_safe():
+    def _seed():
         try:
-            _baselines()
+            box["seed"] = model.host_seed(
+                max_level_states=800_000, max_total=1_000_000
+            )
         except Exception as e:  # noqa: BLE001
-            base["err"] = e
+            box["err"] = e
 
-    bt = threading.Thread(target=_baselines_safe)
-    bt.start()
+    seed_t = threading.Thread(target=_seed)
+    seed_t.start()
     compile_s = ck.warmup(seed=True)
     print(f"compile warmup: {compile_s:.1f}s", file=sys.stderr)
     print(f"  compile breakdown: {ck.last_stats}", file=sys.stderr)
-    # the baselines overlap only the (host-idle) compile wait; join
-    # BEFORE the timed device run so neither measurement contends
-    bt.join()
-    if "err" in base:
-        raise base["err"]
-    seed = base["seed"]
+    seed_t.join()
+    if "err" in box:
+        raise box["err"]
+    seed = box["seed"]
     print(
         f"seed prefix: {len(seed[0])} states / {len(seed[3])} levels",
         file=sys.stderr,
     )
     r = ck.run(seed=seed)
+    # CPU baselines AFTER the device run: XLA compiles run in a LOCAL
+    # helper subprocess (the round-4 try that measured them during
+    # warmup saw the native baseline halved by CPU contention on this
+    # 1-core image), and the run's host side is fetch-bound
+    base = {
+        "native": measure_native_baseline(c, threads=1),
+        "native8": measure_native_baseline(c, threads=8),
+        "py": measure_python_baseline(c, BASELINE_SLICE_S),
+    }
     print(
         f"tpu: {r.distinct_states} states in {r.wall_s:.1f}s "
         f"({r.states_per_sec:.0f} st/s), {r.diameter} levels, "
@@ -297,10 +301,12 @@ def main():
                 "levels": r.diameter,
                 "distinct_states": r.distinct_states,
                 "sustained_last_level_sps": (
-                    round(last_level_sps, 1) if last_level_sps else None
+                    round(last_level_sps, 1)
+                    if last_level_sps is not None else None
                 ),
                 "sustained_final_60s_sps": (
-                    round(final60_sps, 1) if final60_sps else None
+                    round(final60_sps, 1)
+                    if final60_sps is not None else None
                 ),
                 "host_wait_s": (
                     round(host_wait, 2) if host_wait is not None else None

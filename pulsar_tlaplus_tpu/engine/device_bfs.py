@@ -645,6 +645,25 @@ class DeviceChecker:
         write = self._seed_write_jit()
         NCs = self.SEED_CHUNK
         W = self.W
+        # ONE bulk H2D per array (the tunnel moves ~20 MB/s with a
+        # ~130 ms round trip — per-chunk transfers made the seed load
+        # cost ~5 s of the round-4 bench's 22 s run); chunks below are
+        # device-side slices of these
+        # chunk starts are level-relative (off + c0 < n), so the last
+        # slice can extend past n by up to NCs; pad a full extra chunk
+        # or dynamic_slice would clamp the start and merge SHIFTED rows
+        npad = -(-n // NCs) * NCs + NCs
+        rows_d = jnp.asarray(
+            np.concatenate(
+                [rows, np.zeros((npad - n, W), np.uint32)]
+            )
+        )
+        par_d = jnp.asarray(
+            np.concatenate([parents, np.zeros(npad - n, np.int32)])
+        )
+        lan_d = jnp.asarray(
+            np.concatenate([lanes, np.zeros(npad - n, np.int32)])
+        )
         vks = tuple(
             jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
             for _ in range(self.K)
@@ -654,16 +673,13 @@ class DeviceChecker:
         for count in lsizes:
             for c0 in range(0, count, NCs):
                 cn = min(NCs, count - c0)
-                chunk = np.zeros((NCs, W), np.uint32)
-                chunk[:cn] = rows[off + c0: off + c0 + cn]
-                par = np.zeros((NCs,), np.int32)
-                par[:cn] = parents[off + c0: off + c0 + cn]
-                lan = np.zeros((NCs,), np.int32)
-                lan[:cn] = lanes[off + c0: off + c0 + cn]
-                jrows = jnp.asarray(chunk)
+                s0 = off + c0
+                jrows = lax.dynamic_slice(
+                    rows_d, (s0, 0), (NCs, W)
+                )
                 out = merge(
                     *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
-                    jnp.int32(off + c0),
+                    jnp.int32(s0),
                 )
                 vks = out[: self.K]
                 n_vis, st["viol"] = out[self.K], out[self.K + 1]
@@ -671,8 +687,10 @@ class DeviceChecker:
                     bufs["rows"], bufs["parent"], bufs["lane"],
                 ) = write(
                     bufs["rows"], bufs["parent"], bufs["lane"],
-                    jrows, jnp.asarray(par), jnp.asarray(lan),
-                    jnp.int32(off + c0),
+                    jrows,
+                    lax.dynamic_slice(par_d, (s0,), (NCs,)),
+                    lax.dynamic_slice(lan_d, (s0,), (NCs,)),
+                    jnp.int32(s0),
                 )
             off += count
         if int(np.asarray(n_vis)) != n:

@@ -335,10 +335,8 @@ class LivenessChecker:
         return self._edge_cache
 
     def _table_cap(self, n: int) -> int:
-        cap = self.F  # multiple of the goal/sweep chunk
-        while cap < n:
-            cap += self.F
-        return cap
+        # round up to a multiple of the goal/sweep chunk
+        return max(self.F, -(-n // self.F) * self.F)
 
     # -------------------------------------------------------------- run
 

@@ -348,7 +348,14 @@ class ShardedDeviceChecker:
     def _calc_route(self):
         """Derive every route-capacity-dependent size from the current
         ``route_slack`` (re-run by overflow recovery)."""
-        if len(self._axes) == 1:
+        if self.N == 1:
+            # singleton mesh: no routing at all (the n=1 fast path
+            # appends lanes straight into the accumulator), so no
+            # slack inflation either — shapes match the single-chip
+            # engine exactly
+            self.CAPO = self.NCs
+            self.RCV = self.NCs
+        elif len(self._axes) == 1:
             self.CAPO = int(-(-self.NCs * self.route_slack // self.N))
             self.RCV = self.N * self.CAPO
         else:
@@ -362,20 +369,46 @@ class ShardedDeviceChecker:
         self.C = -(-self.ACAP // self.SLc)
         self.APAD = self.C * self.SLc
 
+    def _dev_fill(self, shape, fill, dtype):
+        """Constant-filled sharded buffer, materialized ON DEVICE.
+        ``jnp.zeros(..., device=NamedSharding)`` builds the array on
+        the host and ships it through the tunnel — at bench tiers the
+        ~6 GB of zero buffers took ~75 s at the tunnel's ~80 MB/s and
+        were silently charged to the first BFS levels (measured,
+        scripts/probe_sharded_latency.py / bench_sharded_n1)."""
+        key = ("fill", shape, jnp.dtype(dtype).name)
+        fn = self._jits.get(key)
+        if fn is None:
+            # shard_map forces one per-device block fill (a plain
+            # jitted constant gets folded to a replicated constant that
+            # fights the sharding annotation); the fill value rides as
+            # a traced argument
+            block = (1,) + tuple(shape[1:])
+            fn = jax.jit(
+                jax.shard_map(
+                    lambda v: jnp.broadcast_to(v, block),
+                    mesh=self.mesh,
+                    in_specs=P(),
+                    out_specs=P(self._axes),
+                    check_vma=False,
+                )
+            )
+            self._jits[key] = fn
+        return fn(jnp.asarray(fill, dtype))
+
     def _alloc_acc(self, bufs):
         """(Re)allocate the per-shard accumulator buffers at the
         current ACAP (fresh run, overflow recovery, restore)."""
-        sh = self._shard()
         N, K = self.N, self.K
         bufs["ak"] = tuple(
-            jnp.full((N, self.ACAP), SENTINEL, jnp.uint32, device=sh)
+            self._dev_fill((N, self.ACAP), SENTINEL, jnp.uint32)
             for _ in range(K)
         )
-        bufs["arows"] = jnp.zeros(
-            (N, self.W, self.ACAP), jnp.uint32, device=sh
+        bufs["arows"] = self._dev_fill(
+            (N, self.W, self.ACAP), 0, jnp.uint32
         )
-        bufs["apar"] = jnp.zeros((N, self.ACAP), jnp.int32, device=sh)
-        bufs["alane"] = jnp.zeros((N, self.ACAP), jnp.int32, device=sh)
+        bufs["apar"] = self._dev_fill((N, self.ACAP), 0, jnp.int32)
+        bufs["alane"] = self._dev_fill((N, self.ACAP), 0, jnp.int32)
 
     def _shard_idx(self):
         """Traced global shard index inside a shard_map body."""
@@ -388,6 +421,22 @@ class ShardedDeviceChecker:
     def _route_acc(
         self, kcols, packed, par, lane, ak, arows, apar, alane, acc_off
     ):
+        if self.N == 1:
+            # -workers 1 must not be a perf trap (VERDICT r3 #4): the
+            # one-hot bucketing + all_to_all cost ~2 s/round in plane
+            # scatters on a singleton mesh where every lane is already
+            # home — append lanes directly, exactly like the
+            # single-chip engine's expand tail
+            ak = tuple(
+                lax.dynamic_update_slice(a, c, (acc_off,))
+                for a, c in zip(ak, kcols)
+            )
+            arows = lax.dynamic_update_slice(
+                arows, packed.T, (0, acc_off)
+            )
+            apar = lax.dynamic_update_slice(apar, par, (acc_off,))
+            alane = lax.dynamic_update_slice(alane, lane, (acc_off,))
+            return ak, arows, apar, alane, jnp.bool_(False)
         if len(self._axes) == 1:
             return _route_accumulate(
                 kcols, packed, par, lane, ak, arows, apar, alane,
@@ -668,28 +717,34 @@ class ShardedDeviceChecker:
             live = lanei < n_new
             par = jnp.where(live, par, 0)
             lane = jnp.where(live, lane, 0)
-            if n_inv:
-                pad = C * SL - ACAP
-                ecols = (
-                    tuple(
-                        jnp.concatenate(
-                            [c, jnp.zeros((pad,), jnp.uint32)]
-                        )
-                        for c in ccols
+            pad = C * SL - ACAP
+            ecols = (
+                tuple(
+                    jnp.concatenate(
+                        [c, jnp.zeros((pad,), jnp.uint32)]
                     )
-                    if pad
-                    else ccols
+                    for c in ccols
                 )
+                if pad
+                else ccols
+            )
 
-                def chunk(viol, c):
-                    off = c * SL
-                    rws = jnp.stack(
-                        [
-                            lax.dynamic_slice(col, (off,), (SL,))
-                            for col in ecols
-                        ],
-                        axis=1,
-                    )
+            # one SL-chunked scan does BOTH invariant evaluation and
+            # the row-store append (same shape as device_bfs: a
+            # monolithic [ACAP, W] stack takes the 128-padded tiled
+            # layout — 6.4x memory — and OOMs the XLA planner at
+            # bench-tier accumulators)
+            def chunk(carry, c):
+                viol, store = carry
+                off = c * SL
+                rws = jnp.stack(
+                    [
+                        lax.dynamic_slice(col, (off,), (SL,))
+                        for col in ecols
+                    ],
+                    axis=1,
+                )
+                if n_inv:
                     gids = (shard << self.SB) | (
                         n_visited + off
                         + jnp.arange(SL, dtype=jnp.int32)
@@ -703,14 +758,15 @@ class ShardedDeviceChecker:
                         ok = jax.vmap(fn)(states)
                         bad = livec & ~ok
                         vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
-                    return jnp.minimum(viol, jnp.stack(vnew)), None
-
-                viol, _ = lax.scan(
-                    chunk, viol, jnp.arange(C, dtype=jnp.int32)
+                    viol = jnp.minimum(viol, jnp.stack(vnew))
+                store = lax.dynamic_update_slice(
+                    store, rws.reshape(SL * W),
+                    ((n_visited + off) * W,),
                 )
-            rows_flat = jnp.stack(ccols, axis=1).reshape(ACAP * W)
-            rows = lax.dynamic_update_slice(
-                rows, rows_flat, (n_visited * W,)
+                return (viol, store), None
+
+            (viol, rows), _ = lax.scan(
+                chunk, (viol, rows), jnp.arange(C, dtype=jnp.int32)
             )
             parent_log = lax.dynamic_update_slice(
                 parent_log, par, (n_visited,)
@@ -757,8 +813,9 @@ class ShardedDeviceChecker:
                 jnp.concatenate(
                     [
                         col,
-                        jnp.full((self.N, pad), SENTINEL, jnp.uint32,
-                                 device=self._shard()),
+                        self._dev_fill(
+                            (self.N, pad), SENTINEL, jnp.uint32
+                        ),
                     ],
                     axis=1,
                 )
@@ -775,8 +832,9 @@ class ShardedDeviceChecker:
             bufs["rows"] = jnp.concatenate(
                 [
                     bufs["rows"],
-                    jnp.zeros((self.N, pad * self.W), jnp.uint32,
-                              device=self._shard()),
+                    self._dev_fill(
+                        (self.N, pad * self.W), 0, jnp.uint32
+                    ),
                 ],
                 axis=1,
             )
@@ -784,8 +842,7 @@ class ShardedDeviceChecker:
                 bufs[k] = jnp.concatenate(
                     [
                         bufs[k],
-                        jnp.zeros((self.N, pad), jnp.int32,
-                                  device=self._shard()),
+                        self._dev_fill((self.N, pad), 0, jnp.int32),
                     ],
                     axis=1,
                 )
@@ -898,34 +955,38 @@ class ShardedDeviceChecker:
             raise ValueError("per-shard store exceeds local-gid bits")
         sh = self._shard()
 
-        def pad_cols(name, fill):
-            a = d[name]
-            out = np.full((N, self.VCAP), fill, a.dtype)
-            out[:, :mx] = a
-            return jax.device_put(out, sh)
+        # only the REAL data crosses the tunnel; the (much larger)
+        # capacity padding is a device-side fill concatenated on device
+        def pad_to(name, width, fill, dtype):
+            a = np.ascontiguousarray(d[name], dtype)
+            return jnp.concatenate(
+                [
+                    jax.device_put(a, sh),
+                    self._dev_fill(
+                        (N, width - a.shape[1]), fill, dtype
+                    ),
+                ],
+                axis=1,
+            )
 
         bufs = {
             "vk": tuple(
-                pad_cols(f"vk{i}", np.uint32(0xFFFFFFFF))
+                pad_to(f"vk{i}", self.VCAP, SENTINEL, jnp.uint32)
                 for i in range(K)
             ),
         }
         self._alloc_acc(bufs)
-        rows = np.zeros((N, self.LCAP * W), np.uint32)
-        rows[:, : mx * W] = d["rows"]
-        bufs["rows"] = jax.device_put(rows, sh)
-        for name in ("parent", "lane"):
-            a = np.zeros((N, self.LCAP), np.int32)
-            a[:, :mx] = d[name]
-            bufs[name] = jax.device_put(a, sh)
+        bufs["rows"] = pad_to("rows", self.LCAP * W, 0, jnp.uint32)
+        bufs["parent"] = pad_to("parent", self.LCAP, 0, jnp.int32)
+        bufs["lane"] = pad_to("lane", self.LCAP, 0, jnp.int32)
         n_inv = len(self.invariant_names)
         st = {
             "n_visited": jax.device_put(
                 nvis.astype(np.int32), sh
             ),
-            "dead": jnp.full((N,), int(BIG), jnp.int32, device=sh),
-            "viol": jnp.full((N, n_inv), int(BIG), jnp.int32, device=sh),
-            "ovf": jnp.zeros((N,), jnp.bool_, device=sh),
+            "dead": self._dev_fill((N,), int(BIG), jnp.int32),
+            "viol": self._dev_fill((N, n_inv), int(BIG), jnp.int32),
+            "ovf": self._dev_fill((N,), 0, jnp.bool_),
         }
         return (
             bufs, st, [int(x) for x in d["level_sizes"]],
@@ -934,6 +995,81 @@ class ShardedDeviceChecker:
         )
 
     # --------------------------------------------------------------- run
+
+    def warmup(self) -> float:
+        """Compile every hot-path program on dummy data, outside any
+        timed budget; returns compile wall time, per-stage times in
+        ``last_stats``.  Without this the lazy compiles (~6-8 min at
+        bench tiers) eat the run's time budget — the round-4 n=1 bench
+        found the capped "warm run" truncating on its own budget before
+        the ROUND program ever compiled, leaving a 2-minute compile
+        stall inside the measured run."""
+        t0 = time.time()
+        self.last_stats = {}
+        tlast = [t0]
+
+        def mark(stage):
+            now = time.time()
+            self.last_stats[f"compile_{stage}_s"] = round(
+                now - tlast[0], 1
+            )
+            tlast[0] = now
+
+        def drain(o):
+            leaf = jax.tree_util.tree_leaves(o)[0]
+            np.asarray(jnp.ravel(leaf)[0])
+
+        N, K = self.N, self.K
+        n_inv = len(self.invariant_names)
+        bufs = {}
+        self._alloc_acc(bufs)
+        bufs["vk"] = tuple(
+            self._dev_fill((N, self.VCAP), SENTINEL, jnp.uint32)
+            for _ in range(K)
+        )
+        bufs["rows"] = self._dev_fill(
+            (N, self.LCAP * self.W), 0, jnp.uint32
+        )
+        bufs["parent"] = self._dev_fill((N, self.LCAP), 0, jnp.int32)
+        bufs["lane"] = self._dev_fill((N, self.LCAP), 0, jnp.int32)
+        ovf = self._dev_fill((N,), 0, jnp.bool_)
+        dead = self._dev_fill((N,), int(BIG), jnp.int32)
+        viol = self._dev_fill((N, n_inv), int(BIG), jnp.int32)
+        nvis = self._dev_fill((N,), 0, jnp.int32)
+        mark("alloc")
+        out = self._init_round_jit()(
+            bufs["ak"], bufs["arows"], bufs["apar"], bufs["alane"],
+            ovf, jnp.int32(0), jnp.int32(0),
+        )
+        drain(out)
+        bufs["ak"] = tuple(out[0])
+        bufs["arows"], bufs["apar"], bufs["alane"], ovf = out[1:]
+        mark("initround")
+        zq = jax.device_put(
+            np.zeros((N,), np.int32), self._shard()
+        )
+        out = self._round_jit()(
+            bufs["ak"], bufs["arows"], bufs["apar"], bufs["alane"],
+            bufs["rows"], zq, zq, dead, ovf, jnp.int32(0),
+            jnp.int32(0),
+        )
+        drain(out)
+        bufs["ak"] = tuple(out[0])
+        bufs["arows"], bufs["apar"], bufs["alane"], dead, ovf = out[1:]
+        mark("round")
+        out = self._flush_jit()(bufs["vk"], bufs["ak"], jnp.int32(0))
+        drain(out)
+        bufs["vk"] = tuple(out[0])
+        mark("flush")
+        app = self._append_jit()(
+            bufs["rows"], bufs["parent"], bufs["lane"], bufs["arows"],
+            bufs["apar"], bufs["alane"], out[2], out[1], nvis, viol,
+        )
+        drain(app)
+        mark("append")
+        drain(self._stats_jit()(nvis, dead, viol, ovf))
+        mark("misc")
+        return time.time() - t0
 
     def run(self, resume: bool = False) -> CheckerResult:
         t0 = time.time()
@@ -952,30 +1088,23 @@ class ShardedDeviceChecker:
             t0 = time.time() - saved_wall
             self._host_wait_s = 0.0
             return self._run_levels(t0, bufs, st, level_sizes, lb, nf)
-        sh = self._shard()
         bufs = {
             "vk": tuple(
-                jnp.full((N, self.VCAP), SENTINEL, jnp.uint32, device=sh)
+                self._dev_fill((N, self.VCAP), SENTINEL, jnp.uint32)
                 for _ in range(K)
             ),
-            "ak": tuple(
-                jnp.full((N, self.ACAP), SENTINEL, jnp.uint32, device=sh)
-                for _ in range(K)
+            "rows": self._dev_fill(
+                (N, self.LCAP * self.W), 0, jnp.uint32
             ),
-            "arows": jnp.zeros((N, self.W, self.ACAP), jnp.uint32,
-                               device=sh),
-            "apar": jnp.zeros((N, self.ACAP), jnp.int32, device=sh),
-            "alane": jnp.zeros((N, self.ACAP), jnp.int32, device=sh),
-            "rows": jnp.zeros((N, self.LCAP * self.W), jnp.uint32,
-                              device=sh),
-            "parent": jnp.zeros((N, self.LCAP), jnp.int32, device=sh),
-            "lane": jnp.zeros((N, self.LCAP), jnp.int32, device=sh),
+            "parent": self._dev_fill((N, self.LCAP), 0, jnp.int32),
+            "lane": self._dev_fill((N, self.LCAP), 0, jnp.int32),
         }
+        self._alloc_acc(bufs)
         st = {
-            "n_visited": jnp.zeros((N,), jnp.int32, device=sh),
-            "dead": jnp.full((N,), int(BIG), jnp.int32, device=sh),
-            "viol": jnp.full((N, n_inv), int(BIG), jnp.int32, device=sh),
-            "ovf": jnp.zeros((N,), jnp.bool_, device=sh),
+            "n_visited": self._dev_fill((N,), 0, jnp.int32),
+            "dead": self._dev_fill((N,), int(BIG), jnp.int32),
+            "viol": self._dev_fill((N, n_inv), int(BIG), jnp.int32),
+            "ovf": self._dev_fill((N,), 0, jnp.bool_),
         }
         self._host_wait_s = 0.0
 
@@ -1066,7 +1195,7 @@ class ShardedDeviceChecker:
             )
         self._jits.clear()
         self._alloc_acc(bufs)
-        st["ovf"] = jnp.zeros((self.N,), jnp.bool_, device=self._shard())
+        st["ovf"] = self._dev_fill((self.N,), 0, jnp.bool_)
         self._log(
             f"routing overflow: retrying with route_slack="
             f"{self.route_slack} (ACAP={self.ACAP})"
@@ -1134,15 +1263,28 @@ class ShardedDeviceChecker:
             ):
                 self._save_checkpoint(bufs, st, level_sizes, lb, nf, t0)
 
+    def _dbg(self, tag, tref):
+        """Per-dispatch wall timing, enabled by SHARDED_TIMING=1 (read
+        per call so callers can toggle it after import)."""
+        import os
+
+        if os.environ.get("SHARDED_TIMING"):
+            now = time.time()
+            self._log(f"      {tag}: +{now - tref[0]:.2f}s")
+            tref[0] = now
+
     def _run_one_level(self, t0, bufs, st, stats, nv, lb, nf):
         """Expand one full level; returns (stats, nv2, stop)."""
+        tref = [time.time()]
         self._grow_store(bufs, int((lb + nf).max()) + self.G)
+        self._dbg("grow", tref)
         lb_dev = jax.device_put(
             np.asarray(lb, np.int32), self._shard()
         )
         nf_dev = jax.device_put(
             np.asarray(nf, np.int32), self._shard()
         )
+        self._dbg("device_put lb/nf", tref)
         rounds = int(-(-nf.max() // self.G))
         stop = False
         pending = 0
@@ -1161,6 +1303,7 @@ class ShardedDeviceChecker:
                 bufs["arows"], bufs["apar"], bufs["alane"],
                 st["dead"], st["ovf"],
             ) = out[1:]
+            self._dbg(f"round {r} dispatch", tref)
             w += 1
             if w < self.FLUSH and not last:
                 continue
@@ -1187,9 +1330,11 @@ class ShardedDeviceChecker:
                         bufs, int(nv.max()) + head + self.APAD
                     )
             self._flush(bufs, st, w * self.RCV)
+            self._dbg("flush+append dispatch", tref)
             pending += 1
             w = 0
         stats = self._fetch(st)
+        self._dbg("level-end fetch", tref)
         return stats, stats[:, 0].copy(), stop
 
     # ----------------------------------------------------------- control

@@ -23,7 +23,7 @@ import json  # noqa: E402
 
 def main():
     budget_s = float(sys.argv[1]) if len(sys.argv) > 1 else 150.0
-    max_states = int(sys.argv[2]) if len(sys.argv) > 2 else 30_000_000
+    max_states = int(sys.argv[2]) if len(sys.argv) > 2 else 24_000_000
     from pulsar_tlaplus_tpu.engine.sharded_device import (
         ShardedDeviceChecker,
     )
@@ -45,7 +45,7 @@ def main():
         n_devices=1,
         sub_batch=1 << 18,
         expand_chunk=1 << 13,
-        visited_cap=1 << 27,
+        visited_cap=1 << 26,
         max_states=max_states,
         time_budget_s=budget_s,
         progress=True,
@@ -53,15 +53,8 @@ def main():
         flush_factor=2,
         append_chunk=1 << 17,
     )
-    # the sharded engine compiles lazily inside run(); a short capped
-    # run first absorbs every compile (same jit keys — SCAP is not
-    # shape-relevant), so the reported run is compile-clean
-    t0 = time.time()
-    ck.SCAP = 2_000_000
-    ck.run()
-    compile_s = time.time() - t0
-    print(f"warm run (compiles): {compile_s:.1f}s", flush=True)
-    ck.SCAP = max_states
+    compile_s = ck.warmup()
+    print(f"warmup: {compile_s:.1f}s  {ck.last_stats}", flush=True)
     t0 = time.time()
     r = ck.run()
     wall = time.time() - t0
