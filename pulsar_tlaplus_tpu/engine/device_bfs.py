@@ -47,6 +47,7 @@ through the model on the host (SURVEY.md §2.2-E7).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -56,6 +57,8 @@ import numpy as np
 from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
+from pulsar_tlaplus_tpu.utils import device
+from pulsar_tlaplus_tpu.utils.aot_cache import ajit
 from pulsar_tlaplus_tpu.ops import dedup
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
@@ -185,6 +188,12 @@ class DeviceChecker:
             self.SEED_VCAP = self._round_cap(seed_cap)
         self._jits: Dict[tuple, object] = {}
         self.last_stats: Dict[str, float] = {}
+        # PTT_STAGE_TIMING=1: drain after every dispatch and charge the
+        # wait to per-stage counters (serializes the pipeline; for
+        # profiling only, not the headline path)
+        self._stage_timing = os.environ.get(
+            "PTT_STAGE_TIMING", "0"
+        ) not in ("", "0")
 
     # -------------------------------------------------------------- util
 
@@ -199,6 +208,24 @@ class DeviceChecker:
             import sys
 
             print(f"  {msg}", file=sys.stderr, flush=True)
+
+    def _stage_mark(self, name: str, out):
+        """Stage-timing barrier: drain ``out`` and charge the wait to
+        ``stage_<name>_s`` in ``last_stats`` (one fetch is the only
+        reliable completion barrier on the tunnel backend).  Includes
+        one ~130 ms tunnel RTT per call — subtract ``stage_<name>_n``
+        x RTT when reading the numbers."""
+        if not self._stage_timing:
+            return out
+        t0 = time.time()
+        device.drain(out)
+        self.last_stats[f"stage_{name}_s"] = (
+            self.last_stats.get(f"stage_{name}_s", 0.0) + time.time() - t0
+        )
+        self.last_stats[f"stage_{name}_n"] = (
+            self.last_stats.get(f"stage_{name}_n", 0) + 1
+        )
+        return out
 
     # -------------------------------------------------------- jitted ops
 
@@ -223,7 +250,7 @@ class DeviceChecker:
         def step(rows, off):
             return lax.dynamic_slice(rows, (off * W,), (G * W,))
 
-        fn = jax.jit(step)
+        fn = ajit(step)
         self._jits[key] = fn
         return fn
 
@@ -295,7 +322,7 @@ class DeviceChecker:
             )
             return (*ak, arows, dead)
 
-        fn = jax.jit(step, donate_argnums=tuple(range(self.K + 1)))
+        fn = ajit(step, donate_argnums=tuple(range(self.K + 1)))
         self._jits[key] = fn
         return fn
 
@@ -344,7 +371,7 @@ class DeviceChecker:
             )
             return (*ak, arows)
 
-        fn = jax.jit(step, donate_argnums=tuple(range(self.K + 1)))
+        fn = ajit(step, donate_argnums=tuple(range(self.K + 1)))
         self._jits[key] = fn
         return fn
 
@@ -389,7 +416,7 @@ class DeviceChecker:
             flag_acc = flag_sorted[sp.shape[0] - ACAP:]
             return (*vk2, n_new, flag_acc)
 
-        fn = jax.jit(step, donate_argnums=tuple(range(self.K)))
+        fn = ajit(step, donate_argnums=tuple(range(self.K)))
         self._jits[key] = fn
         return fn
 
@@ -508,7 +535,7 @@ class DeviceChecker:
                 viol,
             )
 
-        fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        fn = ajit(step, donate_argnums=(0, 1, 2))
         self._jits[key] = fn
         return fn
 
@@ -522,7 +549,7 @@ class DeviceChecker:
                 [jnp.stack([n_visited, dead_gid]), viol]
             )
 
-        fn = jax.jit(step)
+        fn = ajit(step)
         self._jits[key] = fn
         return fn
 
@@ -549,7 +576,7 @@ class DeviceChecker:
             # g_end = the root's (negative) parent entry: -1 - init_idx
             return gids, lanes, g_end
 
-        fn = jax.jit(step)
+        fn = ajit(step)
         self._jits[key] = fn
         return fn
 
@@ -595,7 +622,7 @@ class DeviceChecker:
                 viol = jnp.minimum(viol, jnp.stack(vnew))
             return (*vk2, n_visited + n_new, viol)
 
-        fn = jax.jit(merge, donate_argnums=tuple(range(self.K)))
+        fn = ajit(merge, donate_argnums=tuple(range(self.K)))
         self._jits[key] = fn
         return fn
 
@@ -617,7 +644,7 @@ class DeviceChecker:
             lane_log = lax.dynamic_update_slice(lane_log, lane, (off,))
             return rows_store, parent_log, lane_log
 
-        fn = jax.jit(write, donate_argnums=(0, 1, 2))
+        fn = ajit(write, donate_argnums=(0, 1, 2))
         self._jits[key] = fn
         return fn
 
@@ -762,13 +789,9 @@ class DeviceChecker:
             )
             tlast[0] = now
 
-        def drain(o):
-            # block_until_ready is unreliable on the tunnel backend
-            # (returns at enqueue); a host fetch of one element is a
-            # true completion barrier.  Delete refs right after so the
-            # warmup dummies never coexist in HBM.
-            leaf = jax.tree.leaves(o)[0]
-            np.asarray(jnp.ravel(leaf)[0])
+        # utils.device.drain is the completion barrier; callers delete
+        # refs right after so the warmup dummies never coexist in HBM
+        drain = device.drain
 
         def acc():
             return (
@@ -895,18 +918,24 @@ class DeviceChecker:
             """Dispatch the merge + append for the current accumulator
             fill (``n_acc`` valid lanes covering source rows starting
             at ``acc_base``)."""
-            out = self._flush_jit()(
-                *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
+            out = self._stage_mark(
+                "flush",
+                self._flush_jit()(
+                    *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
+                ),
             )
             bufs["vk"] = out[:K]
             n_new, flag_acc = out[K], out[K + 1]
             (
                 bufs["rows"], bufs["parent"], bufs["lane"],
                 st["n_visited"], st["viol"],
-            ) = self._append_jit()(
-                bufs["rows"], bufs["parent"], bufs["lane"],
-                bufs["arows"], flag_acc, n_new, st["n_visited"],
-                st["viol"], jnp.int32(acc_base), jnp.bool_(is_init),
+            ) = self._stage_mark(
+                "append",
+                self._append_jit()(
+                    bufs["rows"], bufs["parent"], bufs["lane"],
+                    bufs["arows"], flag_acc, n_new, st["n_visited"],
+                    st["viol"], jnp.int32(acc_base), jnp.bool_(is_init),
+                ),
             )
 
         if seed is not None:
@@ -976,13 +1005,16 @@ class DeviceChecker:
             try:
                 for f_off in range(0, nf, self.G):
                     last = f_off + self.G >= nf
-                    out = self._expand_jit()(
-                        *bufs["ak"], bufs["arows"],
-                        self._slice_jit()(
-                            bufs["rows"], jnp.int32(level_base + f_off)
+                    out = self._stage_mark(
+                        "expand",
+                        self._expand_jit()(
+                            *bufs["ak"], bufs["arows"],
+                            self._slice_jit()(
+                                bufs["rows"], jnp.int32(level_base + f_off)
+                            ),
+                            jnp.int32(f_off), jnp.int32(nf), st["dead_gid"],
+                            jnp.int32(level_base), jnp.int32(w * self.NCs),
                         ),
-                        jnp.int32(f_off), jnp.int32(nf), st["dead_gid"],
-                        jnp.int32(level_base), jnp.int32(w * self.NCs),
                     )
                     bufs["ak"], bufs["arows"] = out[:K], out[K]
                     st["dead_gid"] = out[K + 1]

@@ -51,6 +51,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from pulsar_tlaplus_tpu.utils import device
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.ops import dedup
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
@@ -1015,9 +1016,7 @@ class ShardedDeviceChecker:
             )
             tlast[0] = now
 
-        def drain(o):
-            leaf = jax.tree_util.tree_leaves(o)[0]
-            np.asarray(jnp.ravel(leaf)[0])
+        drain = device.drain
 
         N, K = self.N, self.K
         n_inv = len(self.invariant_names)

@@ -1,0 +1,20 @@
+"""Small device-interaction helpers shared by the engines."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def drain(out) -> None:
+    """True completion barrier for a dispatched computation.
+
+    ``block_until_ready`` is unreliable on the tunnel backend (it can
+    return at enqueue time), so the only dependable barrier is a host
+    fetch of one element of one output leaf (~130 ms tunnel RTT).
+    Engines use this for warmup sequencing and stage timing — never on
+    the hot path.
+    """
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(jnp.ravel(leaf)[0])

@@ -1,0 +1,64 @@
+"""AOT executable-cache probe (VERDICT r4 #5): run the device checker
+on the shipped config twice (two processes) and compare warmup time.
+First process compiles + serializes; second should load executables
+from ``PTT_AOT_DIR`` and skip the compile service entirely.
+
+Usage: python scripts/probe_aot.py [--big]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    import jax
+
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+    if "--big" in sys.argv:
+        c = Constants(
+            message_sent_limit=64, compaction_times_limit=3, num_keys=8,
+            num_values=2, retain_null_key=True, max_crash_times=3,
+            model_producer=True, model_consumer=False,
+        )
+        kw = dict(
+            sub_batch=1 << 18, expand_chunk=1 << 13,
+            visited_cap=1 << 27, max_states=60_000_000,
+            flush_factor=2, group=2, seed_cap=1 << 21,
+        )
+    else:
+        c = Constants()
+        kw = dict(sub_batch=1 << 12, visited_cap=1 << 16,
+                  max_states=1 << 20)
+    model = CompactionModel(c)
+    ck = DeviceChecker(model, progress=True, **kw)
+    t0 = time.time()
+    w = ck.warmup(seed=True)
+    print(f"warmup: {w:.1f}s  breakdown: {ck.last_stats}")
+    events = {}
+    for v in ck._jits.values():
+        for ev in getattr(v, "events", {}).values():
+            events[ev] = events.get(ev, 0) + 1
+    print(f"aot events: {events}")
+    if "--big" not in sys.argv:
+        r = ck.run()
+        print(
+            f"run: {r.distinct_states} states, diameter {r.diameter}, "
+            f"{r.wall_s:.1f}s"
+        )
+        assert r.distinct_states == 45198, r.distinct_states
+        assert r.diameter == 20, r.diameter
+        print("oracle pin OK")
+    print(f"total: {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()
