@@ -992,6 +992,10 @@ class DeviceChecker:
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             if nf == 0:
                 return self._result(t0, nv, level_sizes, bufs)
+            if self._stage_timing:
+                self._log(
+                    f"level start: nf={nf} windows={-(-nf // self.G)}"
+                )
             # the level's expand windows slice [level_base + f_off,
             # + G); the last partial window may read up to G rows past
             # the frontier end, so the store must cover it or the

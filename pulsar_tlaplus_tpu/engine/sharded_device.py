@@ -930,8 +930,21 @@ class ShardedDeviceChecker:
         )
 
     def load_checkpoint(self):
-        d = np.load(self.checkpoint_path)
-        sig = d["sig"].tobytes().decode()
+        # a file that isn't this engine's npz layout (round-3 host-staged
+        # checkpoints, arbitrary files) must fail with the same clean
+        # message as a config mismatch, not a raw KeyError/zipfile error
+        # (ADVICE r4)
+        try:
+            d = np.load(self.checkpoint_path)
+            sig = d["sig"].tobytes().decode()
+        except FileNotFoundError:
+            raise  # a missing file is not a format problem
+        except Exception as e:  # noqa: BLE001
+            raise ValueError(
+                f"unrecognized checkpoint format at "
+                f"{self.checkpoint_path!r} — not written by this engine "
+                f"({type(e).__name__}: {e})"
+            ) from e
         if sig != self._config_sig():
             raise ValueError(
                 "checkpoint was written by a different configuration"

@@ -368,7 +368,19 @@ int main(int argc, char **argv) {
       for (auto &o : outs)
         next.insert(next.end(), o.begin(), o.end());
     }
-    if (!next.empty()) levels++;
+    if (!next.empty()) {
+      levels++;
+      // per-level profile on stderr: ground truth for the TPU engine's
+      // level accounting (round 5: the HBM-capped TPU bench truncates
+      // mid-level, so its per-level "+N" lines cannot be read as full
+      // level sizes — this is the authoritative source).  The empty-
+      // frontier iteration is skipped so each level prints exactly once.
+      std::fprintf(stderr,
+                   "{\"level\": %zu, \"new\": %zu, \"cum\": %zu, "
+                   "\"wall_s\": %.3f, \"complete\": %s}\n",
+                   levels, next.size(), seen.count.load(), elapsed(),
+                   truncated ? "false" : "true");
+    }
     frontier.swap(next);
   }
 

@@ -130,14 +130,22 @@ class KeySpec:
         h[-1] = jnp.where(all_sent, h[-1] ^ jnp.uint32(1), h[-1])
         return tuple(h)
 
+    _warned: set = set()
+
     def warn_if_hashed(self, max_states: int):
         """One stderr note when hashed-fingerprint mode engages by
         default (ADVICE r3): dedup turned probabilistic silently for
         wide states — surface it up front, not only in the final
         report.  Engines call this when the caller did not pick
-        ``fp_bits`` explicitly."""
+        ``fp_bits`` explicitly.  Deduplicated per key configuration
+        (ADVICE r4: a bench/test run builds several checkers and the
+        note used to repeat for each)."""
         if self.exact:
             return
+        cfg = (self.total_bits, self.ncols, max_states)
+        if cfg in KeySpec._warned:
+            return
+        KeySpec._warned.add(cfg)
         import sys
 
         print(

@@ -118,7 +118,9 @@ class _AJit:
     def __init__(self, fn, **jit_kwargs):
         self._jit = jax.jit(fn, **jit_kwargs)
         self._compiled: Dict[tuple, Any] = {}
-        self._fallback = False
+        # per-signature: one bad cached entry must not bypass verified
+        # executables already loaded for other signatures of this jit
+        self._fallback_sigs: set = set()
         self._donates = bool(
             jit_kwargs.get("donate_argnums")
             or jit_kwargs.get("donate_argnames")
@@ -160,10 +162,10 @@ class _AJit:
         return comp
 
     def __call__(self, *args):
-        if self._fallback or not enabled():
+        if not enabled():
             return self._jit(*args)
         sig = self._sig(args)
-        if sig is None:
+        if sig is None or sig in self._fallback_sigs:
             return self._jit(*args)
         comp = self._compiled.get(sig)
         if comp is None:
@@ -172,7 +174,7 @@ class _AJit:
             except Exception:  # noqa: BLE001
                 # lowering/compile through the AOT path failed — never
                 # let the cache break the engine
-                self._fallback = True
+                self._fallback_sigs.add(sig)
                 return self._jit(*args)
             self._compiled[sig] = comp
         if getattr(comp, "_ptt_verified", False):
@@ -181,7 +183,7 @@ class _AJit:
             out = comp(*args)
         except Exception:  # noqa: BLE001
             self._compiled.pop(sig, None)
-            self._fallback = True
+            self._fallback_sigs.add(sig)
             # a deserialized entry the runtime rejects would crash every
             # future process too — remove it so the next run recompiles
             # (the cache must never become a correctness dependency)

@@ -24,16 +24,14 @@ def main():
     from pulsar_tlaplus_tpu.ref.pyeval import Constants
 
     if "--big" in sys.argv:
-        c = Constants(
-            message_sent_limit=64, compaction_times_limit=3, num_keys=8,
-            num_values=2, retain_null_key=True, max_crash_times=3,
-            model_producer=True, model_consumer=False,
-        )
-        kw = dict(
-            sub_batch=1 << 18, expand_chunk=1 << 13,
-            visited_cap=1 << 27, max_states=60_000_000,
-            flush_factor=2, group=2, seed_cap=1 << 21,
-        )
+        # import the bench's own config/tier so the cache this probe
+        # populates is exactly the one bench.py loads (the tier shapes
+        # the lowered HLO and thus the cache key — literals here would
+        # silently drift)
+        from bench import scaled_config, BENCH_CHECKER_KW
+
+        c = scaled_config()
+        kw = dict(BENCH_CHECKER_KW)
     else:
         c = Constants()
         kw = dict(sub_batch=1 << 12, visited_cap=1 << 16,

@@ -1,0 +1,65 @@
+"""Round-5 stage profile at exact bench shapes: where does deep-level
+time go (expand vs flush vs append)?  Runs the bench configuration
+with PTT_STAGE_TIMING=1 (serialized pipeline — totals are diagnostic)
+and prints per-stage cumulative seconds + dispatch counts.
+
+Uses the same tiers as bench.py so the AOT cache it populates is the
+one the real bench consumes.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("PTT_STAGE_TIMING", "1")
+
+
+def main():
+    import jax
+
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    from bench import scaled_config, BENCH_CHECKER_KW
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 110.0
+    c = scaled_config()
+    model = CompactionModel(c)
+    ck = DeviceChecker(
+        model,
+        time_budget_s=budget,
+        progress=True,
+        **BENCH_CHECKER_KW,
+    )
+    t0 = time.time()
+    w = ck.warmup(seed=True)
+    print(f"warmup: {w:.1f}s  {ck.last_stats}", file=sys.stderr)
+    seed = model.host_seed(max_level_states=800_000, max_total=1_000_000)
+    print(f"seed: {len(seed[0])} states", file=sys.stderr)
+    r = ck.run(seed=seed)
+    print(
+        f"run: {r.distinct_states} states / {r.diameter} levels in "
+        f"{r.wall_s:.1f}s ({r.states_per_sec:.0f} st/s) "
+        f"truncated={r.truncated}"
+    )
+    stages = {
+        k: v for k, v in ck.last_stats.items() if k.startswith("stage_")
+    }
+    print(f"stage totals: {stages}")
+    # RTT-corrected estimate: each _stage_mark pays ~0.13 s tunnel RTT
+    for name in ("expand", "flush", "append"):
+        s = stages.get(f"stage_{name}_s")
+        n = stages.get(f"stage_{name}_n")
+        if s is not None and n:
+            print(
+                f"  {name}: {s:.1f}s / {n} dispatches "
+                f"(~{s - 0.13 * n:.1f}s est device time)"
+            )
+    print(f"total: {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

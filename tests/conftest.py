@@ -15,6 +15,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The axon sitecustomize force-registers the TPU plugin and overrides
 # jax_platforms programmatically, so the env var alone is not enough.
@@ -24,3 +25,33 @@ jax.config.update(
     os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+# ---- quick tier (VERDICT r4 #9) -------------------------------------
+# `pytest -m quick`: the fast green signal — oracle pins + one engine
+# per family, ~50s total on the 1-core image (full suite: ~770s).
+# Central nodeid list rather than per-file decorators so the tier's
+# composition is reviewable in one place.
+_QUICK = (
+    "test_pyeval_oracle.py",  # every oracle pin
+    "test_packing.py",        # layout round-trip properties
+    "test_device_bfs.py::test_device_engine_shipped_cfg_published_count",
+    "test_device_bfs.py::test_device_engine_leak_counterexample",
+    "test_sharded_device.py::test_sharded_device_counts_identical_across_meshes[8]",
+    "test_codegen.py::test_compiled_shipped_cfg_published_count",
+    "test_actions.py::test_successors_match_oracle[shipped]",
+    "test_engine.py::test_engine_shipped_cfg_published_count",
+    "test_frontend.py::TestOracles::test_shipped_cfg_state_count",
+    "test_native_baseline.py::test_native_baseline_shipped_cfg_published_count",
+)
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        rel = item.nodeid.split("tests/")[-1]
+        if any(
+            rel == q or rel.startswith(q + "::") or rel.startswith(q)
+            and q.endswith(".py")
+            for q in _QUICK
+        ):
+            item.add_marker(pytest.mark.quick)

@@ -44,7 +44,9 @@ def test_compiled_sharded_matches_oracle(module):
     assert got.violation is None and not got.deadlock
 
 
-@pytest.mark.parametrize("name", ["subscription", "bookkeeper"])
+@pytest.mark.parametrize(
+    "name", ["subscription", "bookkeeper", "georeplication"]
+)
 def test_compiled_sharded_original_specs(name):
     from pulsar_tlaplus_tpu.engine.interp_check import InterpChecker
     from pulsar_tlaplus_tpu.frontend.loader import bind_cfg
