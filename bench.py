@@ -39,7 +39,13 @@ import time
 
 BENCH_BUDGET_S = 150.0
 BASELINE_SLICE_S = 30.0
-MAX_STATES = 60_000_000
+# Round 5: the HBM wall is broken by the frontier-window row store
+# (rows_window="frontier" below) — packed rows of past levels are
+# dropped (traces replay from the parent/lane logs), so the 60M-state
+# r4 ceiling (6.2 GB of rows) no longer binds.  150M distinct states
+# fit: visited keys + logs + a 20M-state row window + flush transients
+# ~= 13-14 GB of the 15.75 GB chip.
+MAX_STATES = 150_000_000
 
 # persistent XLA compilation cache: repeated bench runs skip compiles
 # (note: measured ineffective for the tunnel TPU backend — kept for the
@@ -70,12 +76,21 @@ def scaled_config():
 BENCH_CHECKER_KW = dict(
     sub_batch=1 << 18,          # 262144 states -> 8.9M candidate lanes
     expand_chunk=1 << 13,
-    visited_cap=1 << 27,
-    frontier_cap=MAX_STATES,
+    visited_cap=1 << 26,        # tiered: early flushes sort ~94M wide,
+                                # not the final 203M (growth re-jits hit
+                                # the AOT executable cache)
     max_states=MAX_STATES,
     group=2,
-    flush_factor=2,
+    flush_factor=3,             # 26.7M-lane accumulator: ~1/3 fewer
+                                # full-width flushes than r4's ff=2
     seed_cap=1 << 21,
+    rows_window="frontier",
+    row_cap_states=20_000_000,  # >= the deepest completable frontier
+                                # (level 6: 17.2M); level 7's rows are
+                                # kept until the window fills, then
+                                # dropped — it can never complete at any
+                                # feasible HBM (>=210.4M states, native
+                                # ground truth)
 )
 
 
@@ -314,6 +329,7 @@ def main():
                 "compile_breakdown_s": ck.last_stats,
                 "levels": r.diameter,
                 "distinct_states": r.distinct_states,
+                "stop_reason": r.stop_reason,
                 "sustained_last_level_sps": (
                     round(last_level_sps, 1)
                     if last_level_sps is not None else None
@@ -326,9 +342,9 @@ def main():
                     round(host_wait, 2) if host_wait is not None else None
                 ),
                 "fp_collision_prob": r.fp_collision_prob,
-                "engine": "device_bfs r4 (flat row store, flush_factor=2 "
-                "amortized merge, chunked single-key append compaction, "
-                "64-bit fingerprints)",
+                "engine": "device_bfs r5 (frontier-window row store, "
+                "flush_factor=3, dynamic append trip count, AOT "
+                "executable cache, 64-bit fingerprints)",
             }
         )
     )

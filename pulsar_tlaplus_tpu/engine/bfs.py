@@ -56,6 +56,10 @@ class CheckerResult:
     wall_s: float = 0.0
     level_sizes: List[int] = field(default_factory=list)
     truncated: bool = False  # stopped by time/state budget, not exhaustion
+    # why a truncated run stopped: "max_states" | "time_budget" | "hbm"
+    # | "row_window" (frontier-window rows exhausted at a completed
+    # level) | None for non-truncated runs or engines predating this
+    stop_reason: Optional[str] = None
     # expected fingerprint collisions at this state count (birthday
     # bound); 0.0 when dedup keys are exact.  TLC prints the analogous
     # "calculated (optimistic) probability" after every run.
