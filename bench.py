@@ -155,13 +155,23 @@ def sustained_rates(metrics_path, wall_s):
         return None, None
     if len(recs) < 2:
         return None, None
-    last, prev = recs[-1], recs[-2]
-    dt = last["wall_s"] - prev["wall_s"]
+    # trailing records can repeat the final state count (e.g. the
+    # level-boundary record after the stopping fetch) — the last-level
+    # rate is measured over the last record pair with a real increase
+    last = recs[-1]
+    prev = None
+    for r in reversed(recs[:-1]):
+        if r["distinct_states"] < last["distinct_states"]:
+            prev = r
+            break
+        last = r
+    dt = last["wall_s"] - prev["wall_s"] if prev is not None else 0
     last_level = (
         (last["distinct_states"] - prev["distinct_states"]) / dt
-        if dt > 0
+        if prev is not None and dt > 0
         else None
     )
+    last = recs[-1]
     final60 = None
     if wall_s >= 60.0:
         cut = last["wall_s"] - 60.0

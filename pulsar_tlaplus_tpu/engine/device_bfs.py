@@ -1082,6 +1082,12 @@ class DeviceChecker:
         if seed is not None:
             level_sizes = self._load_seed(bufs, st, seed)
             stats = fetch()
+            # early anchor record: the sustained-60s window needs a
+            # reference point before the deep levels begin
+            self._emit_metrics(
+                t0, len(level_sizes), 0, int(stats[0]),
+                level_sizes[-1] if level_sizes else 0,
+            )
             fv = self._first_viol(stats)
             gid = fv[1] if fv is not None else None
             if gid is not None:
@@ -1338,6 +1344,9 @@ class DeviceChecker:
         return best
 
     def _emit_metrics(self, t0, level, level_count, nv, nf):
+        """Every record is kept (duplicate state counts included) —
+        rate consumers skip zero-delta tails themselves (bench.py
+        sustained_rates)."""
         if not self.metrics_path:
             return
         import json

