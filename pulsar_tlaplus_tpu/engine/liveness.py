@@ -86,6 +86,7 @@ class LivenessChecker:
         max_states: int = 50_000_000,
         sweep_chunk: Optional[int] = None,
         n_devices: int = 1,
+        explorer_kw: Optional[dict] = None,
     ):
         goals = getattr(model, "liveness_goals", {})
         if goal not in goals:
@@ -122,6 +123,7 @@ class LivenessChecker:
                 sub_batch=max(256, frontier_chunk),
                 visited_cap=visited_cap,
                 max_states=max_states,
+                **(explorer_kw or {}),
             )
         else:
             from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
@@ -138,6 +140,7 @@ class LivenessChecker:
                 visited_cap=visited_cap,
                 frontier_cap=visited_cap,
                 max_states=max_states,
+                **(explorer_kw or {}),
             )
         self.keys = self._checker.keys  # shared KeySpec (ADVICE r4)
         self.K = self.keys.ncols
@@ -190,6 +193,15 @@ class LivenessChecker:
             self._rows_flat = jnp.asarray(np.concatenate(firsts + rests))
         else:
             self._rows_flat = self._checker.last_bufs["rows"]
+        # the sweep only reads the flat rows: drop the explorer's
+        # visited columns / accumulators / logs so their HBM is
+        # available for the sweep's full-table join temps (in the
+        # sharded branch the per-shard rows too — _rows_flat already
+        # holds the copy)
+        keep = () if self.n_devices > 1 else ("rows",)
+        for k in list(self._checker.last_bufs):
+            if k not in keep:
+                del self._checker.last_bufs[k]
         self._explored = (res.distinct_states, res.level_sizes[0])
         return self._explored
 
@@ -316,10 +328,16 @@ class LivenessChecker:
             is_q = (sp_ & TAG) != 0
             gid = jnp.where(is_q, -1, sp_.astype(jnp.int32))
             # pointer-jumping: a run = 1 unique table entry + its
-            # equal-key queries, so the longest fill distance is NQ;
-            # doubling shifts cover it in ceil(log2 NQ)+1 rounds
+            # equal-key queries; doubling shifts cover a fill distance
+            # of MAXRUN (capped — each unrolled pass materializes
+            # full-width temps, and covering the theoretical NQ worst
+            # case OOMed at 2^20-state chunks).  A key with more than
+            # MAXRUN equal-key queries in one chunk leaves gids at -1,
+            # which map to -2 below — the host fails LOUDLY (same
+            # contract as incomplete exploration), never silently.
+            MAXRUN = min(NQ, 1 << 14)
             d = 1
-            while d <= NQ:
+            while d <= MAXRUN:
                 # shift forward by d: rows [d:] see row [i-d]
                 pks = tuple(
                     jnp.concatenate([jnp.full((d,), SENTINEL), c[:-d]])
@@ -399,8 +417,11 @@ class LivenessChecker:
             dst = np.asarray(dstc[:k]).view(np.int32).astype(np.int64)
             if (dst == -2).any():
                 raise RuntimeError(
-                    "edge sweep found a successor outside the visited "
-                    "set — BFS exploration was incomplete"
+                    "edge sweep could not resolve a successor gid: "
+                    "either BFS exploration was incomplete, or one "
+                    "state has more than MAXRUN (16384) equal-key "
+                    "predecessors inside a single sweep chunk — "
+                    "shrink sweep_chunk or raise the cap"
                 )
             uu = start + idx // A
             src_parts.append(uu)

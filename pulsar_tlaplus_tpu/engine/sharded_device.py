@@ -264,7 +264,10 @@ class ShardedDeviceChecker:
     within sampling noise, so per-shard capacity ~ total / n_shards.
     """
 
-    SB = 26  # local-gid bits in the global id (shard << SB | local)
+    # local-gid bits in the global id (shard << SB | local); derived
+    # per instance: small meshes get the widest possible local stores
+    # (round 5: the fixed SB=26 capped an n=1 store at 67M rows, below
+    # what the 40M-state bench tier needs with its append windows)
 
     def __init__(
         self,
@@ -307,7 +310,9 @@ class ShardedDeviceChecker:
         self.N = n_devices or len(devs)
         if self.N > len(devs):
             raise ValueError(f"need {self.N} devices, have {len(devs)}")
-        if self.N > 1 << (30 - self.SB):
+        # gid = shard << SB | local must stay a positive int32
+        self.SB = 30 - max(0, (self.N - 1).bit_length())
+        if self.SB < 16:
             raise ValueError("too many shards for the global-gid encoding")
         if n_slices > 1:
             # multi-slice: a (dcn, ici) grid — shard s lives at slice
@@ -631,8 +636,13 @@ class ShardedDeviceChecker:
 
     def _init_round_jit(self):
         """Initial-state round: shard s generates init indices
-        [base + s*NCs, base + (s+1)*NCs) and routes them by ownership —
-        the same contract as an expand round (par = -1 - init_idx)."""
+        ``base + s, base + s + N, ...`` (stride N — round 5: with
+        producer-local rows a CONTIGUOUS split handed every init state
+        of a small Init set to shard 0, and since discovery stays on
+        the producing shard the whole mesh degenerated to one working
+        shard; striping balances the roots and therefore the whole
+        search) — same contract as an expand round (par = -1 -
+        init_idx)."""
         key = ("initround",)
         if key in self._jits:
             return self._jits[key]
@@ -643,11 +653,15 @@ class ShardedDeviceChecker:
 
         Fi = self.Fi
 
+        N = self.N
+
         def chunk(start, i):
             # Fi lanes per scan step (an unchunked vmap over all NCs
             # lanes materializes the full unpacked state structs —
             # gigabytes at bench widths)
-            idx = start + i * Fi + jnp.arange(Fi, dtype=jnp.int32)
+            idx = start + (
+                i * Fi + jnp.arange(Fi, dtype=jnp.int32)
+            ) * N
             states = jax.vmap(m.gen_initial)(
                 jnp.where(idx < n_init, idx, 0)
             )
@@ -663,8 +677,8 @@ class ShardedDeviceChecker:
             ak = tuple(a[0] for a in ak)
             arows, apar, alane, ovf = arows[0], apar[0], alane[0], ovf[0]
             aq, aq2 = aq[0], aq2[0]
-            start = base + self._shard_idx() * NCs
-            idx = start + jnp.arange(NCs, dtype=jnp.int32)
+            start = base + self._shard_idx()
+            idx = start + jnp.arange(NCs, dtype=jnp.int32) * N
             _, (kcols, packed) = lax.scan(
                 lambda c, i: (c, chunk(start, i)),
                 0,
@@ -934,14 +948,28 @@ class ShardedDeviceChecker:
         self._jits[key] = fn
         return fn
 
-    def _seed_round_jit(self):
-        """Route one NCs-chunk of local seed-state KEYS to their owner
+    def _seed_src(self, n_states: int) -> tuple:
+        """(SRC, Mp) for a seed of ``n_states``: the seed-round chunk
+        size and the padded per-shard store length.  SRC scales with
+        the seed, never past one expand round — padding the seed
+        arrays to a full NCs window shipped 680 MB through the tunnel
+        for a 51 MB seed (measured: 173 s of the n=1 bench)."""
+        SC = self._seed_chunk()
+        M = -(-n_states // self.N)
+        msc = max(SC, -(-M // SC) * SC)
+        src = max(SC, min((self.NCs // SC) * SC, msc))
+        return src, -(-msc // src) * src
+
+    def _seed_round_jit(self, SRC: int):
+        """Route one SRC-chunk of local seed-state KEYS to their owner
         shards (the regular flush then inserts them; the append is
-        skipped — rows were written by ``_seed_write_jit``)."""
-        key = ("seedround",)
+        skipped — rows were written by ``_seed_write_jit``).  On a
+        singleton mesh the keys pack contiguously at ``w * SRC`` (a
+        partial RCV window would leave stale slots inside n_acc)."""
+        key = ("seedround", SRC)
         if key in self._jits:
             return self._jits[key]
-        NCs, W = self.NCs, self.W
+        W = self.W
         keyspec = self.keys
 
         def body(ak, aq, aq2, ovf, rows_flat, n_local, off, w):
@@ -949,14 +977,23 @@ class ShardedDeviceChecker:
             aq, aq2, ovf = aq[0], aq2[0], ovf[0]
             rows_flat, n_local = rows_flat[0], n_local[0]
             chunk = lax.dynamic_slice(
-                rows_flat, (off * W,), (NCs * W,)
-            ).reshape(NCs, W)
+                rows_flat, (off * W,), (SRC * W,)
+            ).reshape(SRC, W)
             kcols = keyspec.make(chunk)
-            valid = off + jnp.arange(NCs, dtype=jnp.int32) < n_local
+            valid = off + jnp.arange(SRC, dtype=jnp.int32) < n_local
             kcols = tuple(
                 jnp.where(valid, c, SENTINEL) for c in kcols
             )
-            ak, aq, aq2, over = self._route_acc(kcols, ak, aq, aq2, w)
+            if self.N == 1:
+                ak = tuple(
+                    lax.dynamic_update_slice(a, c, (w * SRC,))
+                    for a, c in zip(ak, kcols)
+                )
+                over = jnp.bool_(False)
+            else:
+                ak, aq, aq2, over = self._route_acc(
+                    kcols, ak, aq, aq2, w
+                )
             return (
                 tuple(a[None] for a in ak), aq[None], aq2[None],
                 (ovf | over)[None],
@@ -991,12 +1028,8 @@ class ShardedDeviceChecker:
         mask = par >= 0
         par_new = par.copy()
         par_new[mask] = ((par[mask] % N) << self.SB) | (par[mask] // N)
-        M = -(-n // N)
         SC = self._seed_chunk()
-        NCs = self.NCs
-        # local stores are padded so SC-chunk writes and NCs-chunk key
-        # slices can never clamp
-        Mp = max(-(-M // SC) * SC, -(-M // NCs) * NCs)
+        SRC, Mp = self._seed_src(n)
         npad = N * Mp
 
         def to_shards(a, dtype, width=None):
@@ -1023,10 +1056,13 @@ class ShardedDeviceChecker:
         self._grow_visited(bufs, n + self.ACAP)
         self._grow_store(bufs, Mp + self.APAD)
         sh = self._shard()
+        tref = [time.time()]
         rows_d = jax.device_put(rows_sh, sh)
         par_d = jax.device_put(par_sh, sh)
         lane_d = jax.device_put(lane_sh, sh)
         nloc_d = jax.device_put(counts.astype(np.int32), sh)
+        device.drain(rows_d)
+        self._dbg(f"seed H2D ({rows_sh.nbytes >> 20} MB)", tref)
         write = self._seed_write_jit()
         for off in range(0, Mp, SC):
             (
@@ -1035,15 +1071,17 @@ class ShardedDeviceChecker:
                 bufs["rows"], bufs["parent"], bufs["lane"], st["viol"],
                 rows_d, par_d, lane_d, nloc_d, jnp.int32(off),
             )
+        device.drain(bufs["rows"])  # viol can be 0-width (no invariants)
+        self._dbg(f"seed write x{-(-Mp // SC)}", tref)
         st["n_visited"] = jax.device_put(counts.astype(np.int32), sh)
         # key insertion through the regular routed flush (append
         # skipped — rows are already in place); retried wholesale on a
         # routing overflow, which dedups to a no-op
         while True:
             try:
-                seed_round = self._seed_round_jit()
+                seed_round = self._seed_round_jit(SRC)
                 w = 0
-                for off in range(0, Mp, NCs):
+                for off in range(0, Mp, SRC):
                     out = seed_round(
                         bufs["ak"], bufs["aq"], bufs["aq2"], st["ovf"],
                         rows_d, nloc_d, jnp.int32(off), jnp.int32(w),
@@ -1051,11 +1089,14 @@ class ShardedDeviceChecker:
                     bufs["ak"] = tuple(out[0])
                     bufs["aq"], bufs["aq2"], st["ovf"] = out[1:]
                     w += 1
-                    if w == self.FLUSH or off + NCs >= Mp:
+                    if w == self.FLUSH or off + SRC >= Mp:
+                        # singleton meshes pack contiguously (w * SRC
+                        # keys); routed meshes rebuild full RCV windows
+                        n_acc = w * (SRC if N == 1 else self.RCV)
                         fout = self._flush_jit()(
                             bufs["vk"], bufs["ak"], bufs["aq"],
                             bufs["aq2"], st["n_keys"],
-                            jnp.int32(w * self.RCV),
+                            jnp.int32(n_acc),
                         )
                         bufs["vk"] = tuple(fout[0])
                         st["n_keys"] = fout[1]
@@ -1064,6 +1105,7 @@ class ShardedDeviceChecker:
                 # so the except below can actually engage — without it
                 # dropped seed keys would masquerade as duplicates
                 stats = self._fetch(st)
+                self._dbg("seed key insert", tref)
                 nk = int(stats[:, 1].sum())
                 break
             except _RouteOverflow:
@@ -1175,6 +1217,9 @@ class ShardedDeviceChecker:
                 self.keys.exact,
                 self.N,
                 self._axes,
+                # SB fixes the gid encoding (shard << SB | local); a
+                # frame written under a different split must not resume
+                self.SB,
                 # r5: producer-local rows changed the gid numbering and
                 # the checkpoint fields — r4 frames must not resume
                 "sharded_device_r5",
@@ -1395,9 +1440,7 @@ class ShardedDeviceChecker:
             # inside the timed budget.  The append's outputs are reused
             # as the store dummies: a second LCAP-sized row store here
             # OOMed the 24M-state n=1 bench tier.
-            SC = self._seed_chunk()
-            M = -(-seed_states // N)
-            Mp = max(-(-M // SC) * SC, -(-M // self.NCs) * self.NCs)
+            SRC, Mp = self._seed_src(seed_states)
             rows2, par2, lane2 = app[0], app[1], app[2]
             del app
             srows = self._dev_fill((N, Mp * self.W), 0, jnp.uint32)
@@ -1411,7 +1454,7 @@ class ShardedDeviceChecker:
                 )
             )
             del spar, slane
-            out = self._seed_round_jit()(
+            out = self._seed_round_jit(SRC)(
                 bufs["ak"], bufs["aq"], bufs["aq2"], ovf, srows,
                 nloc, jnp.int32(0), jnp.int32(0),
             )
@@ -1709,7 +1752,12 @@ class ShardedDeviceChecker:
             need_sync = (
                 nk_bound + self.ACAP > self.VCAP
                 or nv_bound + self.APAD > self.LCAP
-                or (nv_bound - self.PACAP) * self.N >= self.SCAP
+                # near the state cap, sync on the OPTIMISTIC bound: at
+                # bench shapes one flush can append a PACAP (~27M) of
+                # states, so letting group flushes fly past SCAP forced
+                # multi-GB row-store growth for states the run would
+                # discard (OOMed the 24M n=1 tier)
+                or nv_bound * self.N >= self.SCAP
                 or pending >= self.group
             )
             if need_sync:
@@ -1728,9 +1776,21 @@ class ShardedDeviceChecker:
                     )
                 if nv_bound + (self.group + 1) * self.PACAP + self.APAD \
                         > self.LCAP:
+                    # headroom for a full group of in-flight flushes,
+                    # but never beyond what the state cap (plus one
+                    # overshooting flush) can actually use.  The cap is
+                    # the GLOBAL SCAP, not SCAP/N: producer-local
+                    # placement can be skewed (a small Init set lands
+                    # on few shards), and an under-grown store means a
+                    # clamped blind DUS — silent row corruption, not an
+                    # error (bitten in round 5's resume testing).
                     self._grow_store(
                         bufs,
-                        int(nv_bound) + (self.group + 1) * self.PACAP
+                        min(
+                            int(nv_bound)
+                            + (self.group + 1) * self.PACAP,
+                            self.SCAP + self.PACAP,
+                        )
                         + self.APAD,
                     )
             self._flush(bufs, st, w * self.RCV)
