@@ -188,6 +188,10 @@ def sustained_rates(metrics_path, wall_s):
             final60 = (
                 last["distinct_states"] - base["distinct_states"]
             ) / (last["wall_s"] - base["wall_s"])
+        elif last["wall_s"] >= 60.0:
+            # a 60-70 s run whose earliest record lands after the cut:
+            # the whole run [0, wall] IS a >= 60 s window
+            final60 = last["distinct_states"] / last["wall_s"]
     return last_level, final60
 
 

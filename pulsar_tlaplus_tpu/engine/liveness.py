@@ -395,14 +395,21 @@ class LivenessChecker:
         starts = list(range(0, n, SF))
         # double-buffer: dispatch chunk k+1 before materializing chunk
         # k, so device compute overlaps the ~130 ms / 20 MB/s tunnel
-        # readback (chunks are independent)
-        pending = []
-        for start in starts[:1]:
-            pending.append(
-                sweep(rows, jnp.int32(start), jnp.int32(n), *targs)
-            )
+        # readback (chunks are independent).  At big sweep chunks two
+        # in-flight join programs double the full-table sort + shift
+        # transients — that OOMed the 29.4M-state tier at SF=2^19 —
+        # so prefetch is disabled there (the per-chunk readback is a
+        # smaller fraction of chunk time at that size anyway).
+        prefetch = SF * A <= (1 << 22)
+        pending = [
+            sweep(rows, jnp.int32(starts[0]), jnp.int32(n), *targs)
+        ]
         for i, start in enumerate(starts):
-            if i + 1 < len(starts):
+            if not pending:  # serial mode: dispatch this chunk now
+                pending.append(
+                    sweep(rows, jnp.int32(start), jnp.int32(n), *targs)
+                )
+            if prefetch and i + 1 < len(starts):
                 pending.append(
                     sweep(
                         rows, jnp.int32(starts[i + 1]), jnp.int32(n),

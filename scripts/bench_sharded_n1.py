@@ -45,7 +45,10 @@ def main():
         n_devices=1,
         sub_batch=1 << 18,
         expand_chunk=1 << 13,
-        visited_cap=1 << 26,
+        visited_cap=1 << 26,  # presized: a mid-run VCAP growth would
+                              # lazy-compile a new flush tier INSIDE the
+                              # timed run (the warmup only covers the
+                              # initial tier; measured 317s stall)
         max_states=max_states,
         time_budget_s=budget_s,
         progress=True,
