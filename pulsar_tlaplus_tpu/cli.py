@@ -132,6 +132,7 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         max_states=args.maxstates,
         progress=True,
         metrics_path=args.metrics,
+        visited_impl=args.visited,
     )
     try:
         r = ck.run()
@@ -312,6 +313,7 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             progress=True,
             checkpoint_path=args.checkpoint,
             n_slices=args.slices,
+            visited_impl=args.visited,
         )
     elif args.sharded:
         if args.sharded_engine == "device":
@@ -407,6 +409,15 @@ def main(argv=None):
         choices=["sort", "hash"],
         default="sort",
         help="sharded visited-set structure (default: sorted columns)",
+    )
+    pc.add_argument(
+        "-visited",
+        choices=["fpset", "sort"],
+        default="fpset",
+        help="device-engine visited-set implementation: 'fpset' (HBM "
+        "hash-table FPSet, default — dedup cost independent of the "
+        "visited count) or 'sort' (the legacy sort-merge flush, kept "
+        "for differential testing)",
     )
     pc.add_argument(
         "-sharded-engine",

@@ -59,7 +59,7 @@ from jax import lax
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.utils import device
 from pulsar_tlaplus_tpu.utils.aot_cache import ajit
-from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops import dedup, fpset
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
@@ -101,6 +101,7 @@ class DeviceChecker:
         seed_cap: Optional[int] = None,
         rows_window: str = "all",
         row_cap_states: Optional[int] = None,
+        visited_impl: str = "fpset",
     ):
         self.model = model
         self.layout = model.layout
@@ -155,6 +156,29 @@ class DeviceChecker:
             self._round_cap(visited_cap),
             max(max_states + self.ACAP, self.ACAP * 2),
         )
+        # Visited-set implementation (round 6 tentpole):
+        #
+        # - "fpset" (default): the HBM-resident hash-table FPSet
+        #   (ops/fpset.py) — dedup cost O(batch * E[probes]) independent
+        #   of the visited count, killing the 3-full-width-sort flush
+        #   that was ~50% of round-5 stage time.  ``VCAP`` keeps its
+        #   meaning (max states admissible before growth); the table
+        #   carries ``TCAP = 2 * VCAP`` slots so the run-loop bound
+        #   ``nv_bound <= VCAP`` IS the load-factor <= 1/2 contract.
+        # - "sort": the legacy sort-merge flush, kept verbatim behind
+        #   this flag for differential testing (bench --visited sort,
+        #   CLI -visited sort).
+        if visited_impl not in ("fpset", "sort"):
+            raise ValueError(
+                f"visited_impl must be fpset|sort: {visited_impl}"
+            )
+        self.visited_impl = visited_impl
+        if visited_impl == "fpset":
+            t = 1 << 11
+            while t < 2 * self.VCAP:
+                t <<= 1
+            self.TCAP = t
+            self.VCAP = t // 2
         # Row-store policy (round 5, VERDICT r4 #2 — break the HBM wall):
         #
         # - ``rows_window="all"`` (default): every discovered state's
@@ -477,6 +501,68 @@ class DeviceChecker:
         self._jits[key] = fn
         return fn
 
+    def _fpflush_jit(self):
+        """fpset-mode flush: probe-or-insert the accumulator keys into
+        the HBM hash table — (table cols, ak cols, n_acc, fpm) ->
+        (table' cols, n_new, flag_acc[ACAP], fpm').
+
+        No visited-width sort anywhere: cost is O(ACAP * E[probes])
+        regardless of how many states have been visited (the round-5
+        structural ceiling).  ``flag_acc`` comes back directly in
+        accumulator order (min-lane-wins == the sort-merge's lowest-
+        slot-wins, so gid assignment is IDENTICAL to the legacy flush),
+        feeding the unchanged append.  ``fpm`` accumulates the
+        per-flush metrics [flushes, probe_rounds, failures] on device;
+        failures (stage overflow / probe limit) surface at the next
+        stats fetch as a hard error — states were dropped, the run
+        cannot continue honestly."""
+        key = ("fpflush", self.TCAP)
+        if key in self._jits:
+            return self._jits[key]
+        ACAP, K = self.ACAP, self.K
+
+        def step(*args):
+            tc = args[:K]
+            ak = args[K: 2 * K]
+            n_acc, fpm = args[2 * K], args[2 * K + 1]
+            lanei = jnp.arange(ACAP, dtype=jnp.int32)
+            amask = lanei < n_acc  # stale tail from a previous fill
+            valid = amask & ~fpset.all_sentinel(ak)
+            is_new, tc2, n_failed, rounds = fpset.lookup_or_insert(
+                tc, ak, valid
+            )
+            n_new = jnp.sum(is_new.astype(jnp.int32))
+            fpm = fpm + jnp.stack(
+                [jnp.int32(1), rounds, n_failed]
+            )
+            return (*tc2, n_new, is_new.astype(jnp.uint32), fpm)
+
+        fn = ajit(step, donate_argnums=tuple(range(self.K)))
+        self._jits[key] = fn
+        return fn
+
+    def _rehash_jit(self):
+        """fpset growth: old table cols -> double-capacity cols + a
+        failure count, fully on device (``fpset.rehash_cols``).  The
+        transient is old+new table — far below the retired flush
+        sort's 3x-visited-width scratch."""
+        key = ("rehash", self.TCAP)
+        if key in self._jits:
+            return self._jits[key]
+        K, TCAP = self.K, self.TCAP
+
+        def step(*old):
+            new, failed = fpset.rehash_cols(
+                old, fpset.empty_cols(2 * TCAP, K)
+            )
+            return (*new, failed)
+
+        # no donation: the inputs are half the output shape, so XLA
+        # could never reuse them (donating only produces warnings)
+        fn = ajit(step)
+        self._jits[key] = fn
+        return fn
+
     # invariant-evaluation chunk for the append: bounds the unpacked-
     # state / invariant intermediates (all proportional to SL lanes; a
     # full-ACAP unpack is multi-GB at bench shapes)
@@ -644,14 +730,21 @@ class DeviceChecker:
         return fn
 
     def _stats_jit(self):
-        key = ("stats",)
+        key = ("stats", self.visited_impl)
         if key in self._jits:
             return self._jits[key]
 
-        def step(n_visited, dead_gid, viol):
-            return jnp.concatenate(
-                [jnp.stack([n_visited, dead_gid]), viol]
-            )
+        if self.visited_impl == "fpset":
+            # stats layout: [nv, dead, viol..., flushes, rounds, failed]
+            def step(n_visited, dead_gid, viol, fpm):
+                return jnp.concatenate(
+                    [jnp.stack([n_visited, dead_gid]), viol, fpm]
+                )
+        else:
+            def step(n_visited, dead_gid, viol):
+                return jnp.concatenate(
+                    [jnp.stack([n_visited, dead_gid]), viol]
+                )
 
         fn = ajit(step)
         self._jits[key] = fn
@@ -725,6 +818,52 @@ class DeviceChecker:
                     )
                 viol = jnp.minimum(viol, jnp.stack(vnew))
             return (*vk2, n_visited + n_new, viol)
+
+        fn = ajit(merge, donate_argnums=tuple(range(self.K)))
+        self._jits[key] = fn
+        return fn
+
+    def _fpseed_merge_jit(self):
+        """fpset-mode seed merge: insert one SEED_CHUNK of host-seeded
+        states straight into the MAIN table (probes are O(chunk)
+        whatever the table size, so the sort path's small-shape
+        SEED_VCAP trick is unnecessary) and fuse the same
+        discovery-time invariant check."""
+        key = ("fpseedmerge", self.TCAP)
+        if key in self._jits:
+            return self._jits[key]
+        NCs, K = self.SEED_CHUNK, self.K
+        layout = self.layout
+        m = self.model
+        inv_fns = [m.invariants[n] for n in self.invariant_names]
+        n_inv = len(self.invariant_names)
+        keyspec = self.keys
+
+        def merge(*args):
+            tc = args[:K]
+            rows, n_valid, n_visited, viol, gid_base, fpm = args[K:]
+            kcols = keyspec.make(rows)
+            lane = jnp.arange(NCs, dtype=jnp.int32)
+            valid = lane < n_valid
+            is_new, tc2, n_failed, rounds = fpset.lookup_or_insert(
+                tc, kcols, valid
+            )
+            if n_inv:
+                states = jax.vmap(layout.unpack)(rows)
+                vnew = []
+                for fn in inv_fns:
+                    ok = jax.vmap(fn)(states)
+                    bad = valid & ~ok
+                    vnew.append(
+                        jnp.min(jnp.where(bad, gid_base + lane, BIG))
+                    )
+                viol = jnp.minimum(viol, jnp.stack(vnew))
+            fpm = fpm + jnp.stack([jnp.int32(1), rounds, n_failed])
+            return (
+                *tc2,
+                n_visited + jnp.sum(is_new.astype(jnp.int32)),
+                viol, fpm,
+            )
 
         fn = ajit(merge, donate_argnums=tuple(range(self.K)))
         self._jits[key] = fn
@@ -808,7 +947,9 @@ class DeviceChecker:
         n = len(rows)
         if sum(lsizes) != n:
             raise ValueError("seed level sizes do not sum to the state count")
-        if n > self.SEED_VCAP // 2 or n > self.SCAP:
+        if n > self.SCAP or (
+            self.visited_impl == "sort" and n > self.SEED_VCAP // 2
+        ):
             raise ValueError(f"seed too large ({n} states)")
         if (
             self.rows_window == "frontier"
@@ -818,12 +959,36 @@ class DeviceChecker:
                 f"seed ({n} states) exceeds the frontier rows window "
                 f"({self.LCAP}); raise row_cap_states"
             )
-        self._grow_visited(bufs, max(n + self.ACAP, self.SEED_VCAP))
+        if (
+            self.rows_window == "frontier"
+            and lsizes
+            and lsizes[-1] + self.APAD > self.LCAP
+        ):
+            # mirror of the init-path guard: the seeded frontier must
+            # leave room for one blind APAD append window, or the first
+            # flush diverts rows to the scratch window at LCAP - APAD —
+            # which OVERLAPS the live frontier rows and silently
+            # corrupts the search (ADVICE r5 medium)
+            raise ValueError(
+                f"seed frontier ({lsizes[-1]} states) exceeds the "
+                f"frontier rows window ({self.LCAP} rows, "
+                f"{self.APAD} reserved for the append); raise "
+                "row_cap_states"
+            )
+        self._grow_visited(
+            bufs,
+            n + self.ACAP
+            if self.visited_impl == "fpset"
+            else max(n + self.ACAP, self.SEED_VCAP),
+        )
         # seed writes are SEED_CHUNK-padded DUS windows starting at
         # offsets up to n, so the store must admit one full chunk past
         # the worst-case write start or the DUS would clamp and corrupt
         self._grow_store(bufs, n + self.SEED_CHUNK)
-        merge = self._seed_merge_jit()
+        if self.visited_impl == "fpset":
+            merge = self._fpseed_merge_jit()
+        else:
+            merge = self._seed_merge_jit()
         write = self._seed_write_jit()
         NCs = self.SEED_CHUNK
         W = self.W
@@ -845,10 +1010,14 @@ class DeviceChecker:
         # happened off the measured path
         _, rows_d, par_d, lan_d = staged
         self._seed_staged = None
-        vks = tuple(
-            jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
-            for _ in range(self.K)
-        )
+        fpmode = self.visited_impl == "fpset"
+        if fpmode:
+            vks = bufs["vk"]  # insert straight into the main table
+        else:
+            vks = tuple(
+                jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
+                for _ in range(self.K)
+            )
         n_vis = jnp.int32(0)
         off = 0
         for count in lsizes:
@@ -858,12 +1027,20 @@ class DeviceChecker:
                 jrows = lax.dynamic_slice(
                     rows_d, (s0, 0), (NCs, W)
                 )
-                out = merge(
-                    *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
-                    jnp.int32(s0),
-                )
-                vks = out[: self.K]
-                n_vis, st["viol"] = out[self.K], out[self.K + 1]
+                if fpmode:
+                    out = merge(
+                        *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
+                        jnp.int32(s0), st["fpm"],
+                    )
+                    vks = out[: self.K]
+                    n_vis, st["viol"], st["fpm"] = out[self.K:]
+                else:
+                    out = merge(
+                        *vks, jrows, jnp.int32(cn), n_vis, st["viol"],
+                        jnp.int32(s0),
+                    )
+                    vks = out[: self.K]
+                    n_vis, st["viol"] = out[self.K], out[self.K + 1]
                 (
                     bufs["rows"], bufs["parent"], bufs["lane"],
                 ) = write(
@@ -874,19 +1051,28 @@ class DeviceChecker:
                     jnp.int32(s0),
                 )
             off += count
+        if fpmode:
+            bufs["vk"] = vks
+            if int(np.asarray(st["fpm"])[2]):
+                raise RuntimeError(
+                    "fpset probe overflow while loading the seed — "
+                    "raise visited_cap"
+                )
         if int(np.asarray(n_vis)) != n:
             raise ValueError(
                 "seed states are not all distinct "
                 f"({int(np.asarray(n_vis))} of {n} unique)"
             )
-        # hand the small sorted columns to the main engine (SENTINEL pad)
-        bufs["vk"] = tuple(
-            jnp.concatenate(
-                [col, jnp.full((self.VCAP - self.SEED_VCAP,), SENTINEL,
-                               jnp.uint32)]
+        if not fpmode:
+            # hand the small sorted columns to the main engine
+            # (SENTINEL pad)
+            bufs["vk"] = tuple(
+                jnp.concatenate(
+                    [col, jnp.full((self.VCAP - self.SEED_VCAP,),
+                                   SENTINEL, jnp.uint32)]
+                )
+                for col in vks
             )
-            for col in vks
-        )
         st["n_visited"] = jnp.int32(n)
         return [int(x) for x in lsizes]
 
@@ -894,6 +1080,22 @@ class DeviceChecker:
 
     def _grow_visited(self, bufs, need: int):
         cap = max(self.SCAP + self.ACAP, self.ACAP * 2)
+        if self.visited_impl == "fpset":
+            # double + on-device rehash, capped at the most any run can
+            # use (nv never exceeds SCAP, so a table admitting
+            # SCAP + ACAP states at load 1/2 never needs to grow again
+            # even when the caller's headroom ask overshoots it)
+            while self.VCAP < need and self.VCAP < cap:
+                out = self._rehash_jit()(*bufs["vk"])
+                bufs["vk"], failed = out[: self.K], out[self.K]
+                if int(np.asarray(failed)):
+                    raise RuntimeError(
+                        "fpset rehash overflow — table corrupted its "
+                        "load-factor contract (bug)"
+                    )
+                self.TCAP *= 2
+                self.VCAP = self.TCAP // 2
+            return
         while self.VCAP < need:
             pad = min(self.VCAP, max(cap - self.VCAP, need - self.VCAP))
             bufs["vk"] = tuple(
@@ -994,13 +1196,29 @@ class DeviceChecker:
         mark("expand")
         ak, arows = out[:K], out[K]
         del window
-        vk = tuple(
-            jnp.full((self.VCAP,), SENTINEL, jnp.uint32) for _ in range(K)
-        )
-        out = self._flush_jit()(*vk, *ak, jnp.int32(0))
-        drain(out)
-        mark("flush")
-        del vk
+        fpmode = self.visited_impl == "fpset"
+        seed_tbl = None
+        if fpmode:
+            tc = fpset.empty_cols(self.TCAP, K)
+            fpm0 = jnp.zeros((3,), jnp.int32)
+            out = self._fpflush_jit()(*tc, *ak, jnp.int32(0), fpm0)
+            drain(out)
+            mark("flush")
+            del tc
+            # the donated-input flush returns the table; reuse it as the
+            # seed-merge compile dummy instead of allocating a second
+            # TCAP-sized table (dropped right away when no seed compile
+            # is coming — it must not squat HBM under the append dummy)
+            seed_tbl = out[:K] if seed else None
+        else:
+            vk = tuple(
+                jnp.full((self.VCAP,), SENTINEL, jnp.uint32)
+                for _ in range(K)
+            )
+            out = self._flush_jit()(*vk, *ak, jnp.int32(0))
+            drain(out)
+            mark("flush")
+            del vk
         flag_w = out[K + 1]
         del out
         viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
@@ -1023,18 +1241,28 @@ class DeviceChecker:
         )
         mark("misc")
         if seed:
-            merge = self._seed_merge_jit()
             write = self._seed_write_jit()
-            vks = tuple(
-                jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
-                for _ in range(K)
-            )
-            drain(
-                merge(
-                    *vks, z((self.SEED_CHUNK, self.W), jnp.uint32),
-                    jnp.int32(0), jnp.int32(0), viol0, jnp.int32(0),
+            if fpmode:
+                drain(
+                    self._fpseed_merge_jit()(
+                        *seed_tbl,
+                        z((self.SEED_CHUNK, self.W), jnp.uint32),
+                        jnp.int32(0), jnp.int32(0), viol0,
+                        jnp.int32(0), jnp.zeros((3,), jnp.int32),
+                    )
                 )
-            )
+            else:
+                merge = self._seed_merge_jit()
+                vks = tuple(
+                    jnp.full((self.SEED_VCAP,), SENTINEL, jnp.uint32)
+                    for _ in range(K)
+                )
+                drain(
+                    merge(
+                        *vks, z((self.SEED_CHUNK, self.W), jnp.uint32),
+                        jnp.int32(0), jnp.int32(0), viol0, jnp.int32(0),
+                    )
+                )
             drain(
                 write(
                     z((self._rows_len(),), jnp.uint32),
@@ -1060,9 +1288,13 @@ class DeviceChecker:
         n_inv = len(self.invariant_names)
         K = self.K
         bufs = {
-            "vk": tuple(
-                jnp.full((self.VCAP,), SENTINEL, jnp.uint32)
-                for _ in range(K)
+            "vk": (
+                fpset.empty_cols(self.TCAP, K)
+                if self.visited_impl == "fpset"
+                else tuple(
+                    jnp.full((self.VCAP,), SENTINEL, jnp.uint32)
+                    for _ in range(K)
+                )
             ),
             "ak": tuple(
                 jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
@@ -1078,17 +1310,43 @@ class DeviceChecker:
             "dead_gid": BIG,
             "viol": jnp.full((n_inv,), int(BIG), jnp.int32),
         }
-        stats_fn = self._stats_jit()
+        fpmode = self.visited_impl == "fpset"
+        if fpmode:
+            # device-accumulated fpset metrics [flushes, probe rounds,
+            # failures] — ride the regular stats fetch
+            st["fpm"] = jnp.zeros((3,), jnp.int32)
 
         self._host_wait_s = 0.0
         self._bufs_poisoned = False
+        self._last_fpm = None
 
         def fetch():
             tf = time.time()
-            out = np.asarray(
-                stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
-            )
+            stats_fn = self._stats_jit()
+            if fpmode:
+                out = np.asarray(
+                    stats_fn(
+                        st["n_visited"], st["dead_gid"], st["viol"],
+                        st["fpm"],
+                    )
+                )
+            else:
+                out = np.asarray(
+                    stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
+                )
             self._host_wait_s += time.time() - tf
+            if fpmode:
+                self._last_fpm = out[2 + n_inv:]
+                if self._last_fpm[2]:
+                    # probe overflow: lanes were dropped by flushes
+                    # already appended — the counts cannot be trusted,
+                    # so this is a hard abort, not a truncation
+                    raise RuntimeError(
+                        "fpset probe overflow "
+                        f"({int(self._last_fpm[2])} lanes) — raise "
+                        "visited_cap (the table broke its load-factor "
+                        "contract)"
+                    )
             return out
 
         # frontier-window state: gid of rows[0], and whether row writes
@@ -1097,17 +1355,32 @@ class DeviceChecker:
         rb = {"row_base": 0, "rows_ok": True}
 
         def flush(n_acc: int, acc_base: int, is_init: bool):
-            """Dispatch the merge + append for the current accumulator
+            """Dispatch the dedup + append for the current accumulator
             fill (``n_acc`` valid lanes covering source rows starting
-            at ``acc_base``)."""
-            out = self._stage_mark(
-                "flush",
-                self._flush_jit()(
-                    *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
-                ),
-            )
-            bufs["vk"] = out[:K]
-            n_new, flag_acc = out[K], out[K + 1]
+            at ``acc_base``): table probe-or-insert in fpset mode, the
+            legacy 3-sort merge in sort mode — identical flag/append
+            contract either way."""
+            if fpmode:
+                out = self._stage_mark(
+                    "flush",
+                    self._fpflush_jit()(
+                        *bufs["vk"], *bufs["ak"], jnp.int32(n_acc),
+                        st["fpm"],
+                    ),
+                )
+                bufs["vk"] = out[:K]
+                n_new, flag_acc, st["fpm"] = (
+                    out[K], out[K + 1], out[K + 2]
+                )
+            else:
+                out = self._stage_mark(
+                    "flush",
+                    self._flush_jit()(
+                        *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
+                    ),
+                )
+                bufs["vk"] = out[:K]
+                n_new, flag_acc = out[K], out[K + 1]
             (
                 bufs["rows"], bufs["parent"], bufs["lane"],
                 st["n_visited"], st["viol"],
@@ -1456,6 +1729,20 @@ class DeviceChecker:
     ) -> CheckerResult:
         self.last_bufs = bufs  # debugging/inspection hook
         wall = time.time() - t0
+        if self.visited_impl == "fpset" and self._last_fpm is not None:
+            # per-run fpset metrics for bench.py artifacts: flush count,
+            # cumulative probe rounds (avg = rounds/flushes), failures
+            # (always 0 here — nonzero aborts at the fetch), and the
+            # final table occupancy
+            fl, rd, fd = (int(x) for x in self._last_fpm[:3])
+            self.last_stats.update(
+                fpset_flushes=fl,
+                fpset_probe_rounds=rd,
+                fpset_avg_probe_rounds=round(rd / max(fl, 1), 2),
+                fpset_failures=fd,
+                fpset_table_cap=self.TCAP,
+                fpset_occupancy=round(nv / max(self.TCAP, 1), 4),
+            )
         res = CheckerResult(
             distinct_states=nv,
             diameter=len(level_sizes),

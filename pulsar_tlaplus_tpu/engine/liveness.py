@@ -87,6 +87,7 @@ class LivenessChecker:
         sweep_chunk: Optional[int] = None,
         n_devices: int = 1,
         explorer_kw: Optional[dict] = None,
+        max_run: int = 1 << 14,
     ):
         goals = getattr(model, "liveness_goals", {})
         if goal not in goals:
@@ -109,6 +110,21 @@ class LivenessChecker:
         # the goal scan chunks by F and the sweep by SF over the same
         # SENTINEL-padded table width, so SF must be a multiple of F
         self.SF = -(-self.SF // self.F) * self.F
+        # pointer-jumping cap for the sweep's equal-key gid propagation
+        # (ADVICE r5): doubling shifts d = 1, 2, ..., p (p = the
+        # largest power of two <= max_run) cover a fill distance of
+        # 2p - 1 equal-key queries per chunk — 32767 at the 2^14
+        # default.  Exposed so the error message's remediation ("raise
+        # max_run") is actionable; each extra doubling materializes one
+        # more set of full-width temps, so very large values trade HBM
+        # for run coverage.
+        if max_run < 1:
+            raise ValueError(f"max_run must be positive: {max_run}")
+        self.max_run = max_run
+        p = 1
+        while p * 2 <= min(max_run, self.SF * model.A):
+            p *= 2
+        self._run_cover = 2 * p - 1
         self.n_devices = n_devices
         if n_devices > 1:
             from pulsar_tlaplus_tpu.engine.sharded_device import (
@@ -328,14 +344,15 @@ class LivenessChecker:
             is_q = (sp_ & TAG) != 0
             gid = jnp.where(is_q, -1, sp_.astype(jnp.int32))
             # pointer-jumping: a run = 1 unique table entry + its
-            # equal-key queries; doubling shifts cover a fill distance
-            # of MAXRUN (capped — each unrolled pass materializes
-            # full-width temps, and covering the theoretical NQ worst
-            # case OOMed at 2^20-state chunks).  A key with more than
-            # MAXRUN equal-key queries in one chunk leaves gids at -1,
-            # which map to -2 below — the host fails LOUDLY (same
-            # contract as incomplete exploration), never silently.
-            MAXRUN = min(NQ, 1 << 14)
+            # equal-key queries; doubling shifts d = 1..MAXRUN cover a
+            # fill distance of 2*MAXRUN - 1 (capped — each unrolled
+            # pass materializes full-width temps, and covering the
+            # theoretical NQ worst case OOMed at 2^20-state chunks).
+            # A key with more equal-key queries in one chunk leaves
+            # gids at -1, which map to -2 below — the host fails
+            # LOUDLY (same contract as incomplete exploration), never
+            # silently.  ``max_run`` (constructor) raises the cap.
+            MAXRUN = min(NQ, self.max_run)
             d = 1
             while d <= MAXRUN:
                 # shift forward by d: rows [d:] see row [i-d]
@@ -426,9 +443,10 @@ class LivenessChecker:
                 raise RuntimeError(
                     "edge sweep could not resolve a successor gid: "
                     "either BFS exploration was incomplete, or one "
-                    "state has more than MAXRUN (16384) equal-key "
+                    f"state has more than {self._run_cover} equal-key "
                     "predecessors inside a single sweep chunk — "
-                    "shrink sweep_chunk or raise the cap"
+                    "shrink sweep_chunk or raise max_run "
+                    f"(currently {self.max_run})"
                 )
             uu = start + idx // A
             src_parts.append(uu)

@@ -53,7 +53,7 @@ from jax.sharding import PartitionSpec as P
 
 from pulsar_tlaplus_tpu.utils import device
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
-from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops import dedup, fpset
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
@@ -290,6 +290,7 @@ class ShardedDeviceChecker:
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 5,
         n_slices: int = 1,
+        visited_impl: str = "fpset",
     ):
         self.model = model
         self.layout = model.layout
@@ -351,7 +352,22 @@ class ShardedDeviceChecker:
         self.K = self.keys.ncols
         if fp_bits is None:
             self.keys.warn_if_hashed(max_states)
+        # Visited-set implementation (round 6): "fpset" = per-shard
+        # ownership-sharded HBM hash tables (ops/fpset.py) — the routed
+        # key planes probe the OWNER's table instead of feeding the
+        # per-shard sort-merge, so owner-side dedup is O(routed batch),
+        # not O(owned keys).  "sort" keeps the legacy flush for
+        # differential testing.  VCAP stays "max owned keys per shard
+        # before growth"; the fpset table carries TCAP = 2 * VCAP slots
+        # so the existing nk_bound <= VCAP invariant IS the load-factor
+        # <= 1/2 contract.
+        if visited_impl not in ("fpset", "sort"):
+            raise ValueError(
+                f"visited_impl must be fpset|sort: {visited_impl}"
+            )
+        self.visited_impl = visited_impl
         self.VCAP = self._round_cap(visited_cap)
+        self.TCAP = 2 * self.VCAP
         self.SCAP = max_states  # global
         self.LCAP = max(
             min(
@@ -371,6 +387,8 @@ class ShardedDeviceChecker:
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self._jits: Dict[tuple, object] = {}
+        self.last_stats: Dict[str, float] = {}
+        self._last_fpm = None
 
     # -------------------------------------------------------------- util
 
@@ -500,6 +518,13 @@ class ShardedDeviceChecker:
         while n < c:
             n <<= 1
         return n
+
+    def _vk_width(self) -> int:
+        """Per-shard width of a visited column: TCAP slots + the trash
+        row in fpset mode, the sorted-column capacity in sort mode."""
+        return (
+            self.TCAP + 1 if self.visited_impl == "fpset" else self.VCAP
+        )
 
     def _log(self, msg: str):
         if self.progress:
@@ -712,35 +737,53 @@ class ShardedDeviceChecker:
         return fn
 
     def _flush_jit(self):
-        """Owner-side sort-merge of the routed key accumulator into the
-        visited set (the shared dedup core), then the positional flag
-        return: owner-order new-flags travel back through the inverse
-        all_to_all(s) and land as PRODUCER-acc-order flags via the
-        saved return addresses — one u32 plane per hop instead of the
-        round-4 design's K+2+W routed planes per round."""
-        key = ("flush", self.VCAP)
+        """Owner-side dedup of the routed key accumulator into the
+        visited set, then the positional flag return: owner-order
+        new-flags travel back through the inverse all_to_all(s) and
+        land as PRODUCER-acc-order flags via the saved return
+        addresses — one u32 plane per hop instead of the round-4
+        design's K+2+W routed planes per round.
+
+        fpset mode (round 6): the routed key planes PROBE the owner's
+        HBM hash table (``fpset.lookup_or_insert``) instead of feeding
+        the per-shard sort-merge — no owned-keys-width sort, no payload
+        projection sort (the probe's is_new IS the owner-acc-order flag
+        vector), and per-shard probe metrics accumulate in ``fpm``."""
+        key = ("flush", self.VCAP, self.visited_impl)
         if key in self._jits:
             return self._jits[key]
         K, ACAP, PACAP = self.K, self.ACAP, self.PACAP
 
-        def body(vk, ak, aq, aq2, n_keys, n_acc):
+        def body(vk, ak, aq, aq2, n_keys, fpm, n_acc):
             vk = tuple(v[0] for v in vk)
             ak = tuple(a[0] for a in ak)
-            aq, aq2, n_keys = aq[0], aq2[0], n_keys[0]
+            aq, aq2, n_keys, fpm = aq[0], aq2[0], n_keys[0], fpm[0]
             lanei = jnp.arange(ACAP, dtype=jnp.int32)
             amask = lanei < n_acc
-            ccols = tuple(jnp.where(amask, a, SENTINEL) for a in ak)
-            cpay = lanei.astype(jnp.uint32) | TAG_BIT
-            vk2, n_new_owner, sp, new_flag = dedup.merge_new_keys(
-                vk, ccols, cpay
-            )
-            # owner-acc-order flags (candidate payloads sort above
-            # visited zeros, ascending by slot — tail of a payload sort)
-            _, flag_sorted = lax.sort(
-                (sp, new_flag.astype(jnp.uint32)), num_keys=1,
-                is_stable=False,
-            )
-            flag_own = flag_sorted[sp.shape[0] - ACAP:]
+            if self.visited_impl == "fpset":
+                valid = amask & ~fpset.all_sentinel(ak)
+                is_new, vk2, n_failed, rounds = fpset.lookup_or_insert(
+                    vk, ak, valid
+                )
+                n_new_owner = jnp.sum(is_new.astype(jnp.int32))
+                flag_own = is_new.astype(jnp.uint32)
+                fpm = fpm + jnp.stack([jnp.int32(1), rounds, n_failed])
+            else:
+                ccols = tuple(
+                    jnp.where(amask, a, SENTINEL) for a in ak
+                )
+                cpay = lanei.astype(jnp.uint32) | TAG_BIT
+                vk2, n_new_owner, sp, new_flag = dedup.merge_new_keys(
+                    vk, ccols, cpay
+                )
+                # owner-acc-order flags (candidate payloads sort above
+                # visited zeros, ascending by slot — the tail of a
+                # payload sort)
+                _, flag_sorted = lax.sort(
+                    (sp, new_flag.astype(jnp.uint32)), num_keys=1,
+                    is_stable=False,
+                )
+                flag_own = flag_sorted[sp.shape[0] - ACAP:]
             if self.N == 1:
                 flag_local = flag_own  # PACAP == ACAP, same order
             elif len(self._axes) == 1:
@@ -762,14 +805,14 @@ class ShardedDeviceChecker:
             return (
                 tuple(v[None] for v in vk2),
                 (n_keys + n_new_owner)[None],
-                n_new_local[None], flag_local[None],
+                n_new_local[None], flag_local[None], fpm[None],
             )
 
         sh = P(self._axes)
         fn = self._smap(
             body,
-            ((sh,) * self.K, (sh,) * self.K, sh, sh, sh, P()),
-            ((sh,) * self.K, sh, sh, sh),
+            ((sh,) * self.K, (sh,) * self.K, sh, sh, sh, sh, P()),
+            ((sh,) * self.K, sh, sh, sh, sh),
             donate=(0,),
         )
         self._jits[key] = fn
@@ -1095,11 +1138,12 @@ class ShardedDeviceChecker:
                         n_acc = w * (SRC if N == 1 else self.RCV)
                         fout = self._flush_jit()(
                             bufs["vk"], bufs["ak"], bufs["aq"],
-                            bufs["aq2"], st["n_keys"],
+                            bufs["aq2"], st["n_keys"], st["fpm"],
                             jnp.int32(n_acc),
                         )
                         bufs["vk"] = tuple(fout[0])
                         st["n_keys"] = fout[1]
+                        st["fpm"] = fout[4]
                         w = 0
                 # the fetch surfaces routing overflows (sticky ovf flag)
                 # so the except below can actually engage — without it
@@ -1121,11 +1165,11 @@ class ShardedDeviceChecker:
         if key in self._jits:
             return self._jits[key]
 
-        def step(n_visited, n_keys, dead, viol, ovf):
+        def step(n_visited, n_keys, dead, viol, ovf, fpm):
             return jnp.concatenate(
                 [
                     n_visited[:, None], n_keys[:, None], dead[:, None],
-                    viol, ovf[:, None].astype(jnp.int32),
+                    viol, ovf[:, None].astype(jnp.int32), fpm,
                 ],
                 axis=1,
             )
@@ -1136,7 +1180,40 @@ class ShardedDeviceChecker:
 
     # ------------------------------------------------------------ growth
 
+    def _rehash_jit(self):
+        """fpset growth: every shard rehashes its own table into a
+        double-capacity one inside the same shard_map dispatch —
+        (vk cols) -> (vk' cols, per-shard failure count)."""
+        key = ("rehash", self.TCAP)
+        if key in self._jits:
+            return self._jits[key]
+        K, TCAP = self.K, self.TCAP
+
+        def body(vk):
+            vk = tuple(v[0] for v in vk)
+            new, failed = fpset.rehash_cols(
+                vk, fpset.empty_cols(2 * TCAP, K)
+            )
+            return tuple(v[None] for v in new), failed[None]
+
+        sh = P(self._axes)
+        fn = self._smap(body, ((sh,) * K,), ((sh,) * K, sh))
+        self._jits[key] = fn
+        return fn
+
     def _grow_visited(self, bufs, need: int):
+        if self.visited_impl == "fpset":
+            while self.VCAP < need:
+                out = self._rehash_jit()(bufs["vk"])
+                bufs["vk"] = tuple(out[0])
+                if np.asarray(out[1]).any():
+                    raise RuntimeError(
+                        "fpset rehash overflow — table corrupted its "
+                        "load-factor contract (bug)"
+                    )
+                self.TCAP *= 2
+                self.VCAP = self.TCAP // 2
+            return
         while self.VCAP < need:
             pad = self.VCAP
             bufs["vk"] = tuple(
@@ -1221,8 +1298,13 @@ class ShardedDeviceChecker:
                 # frame written under a different split must not resume
                 self.SB,
                 # r5: producer-local rows changed the gid numbering and
-                # the checkpoint fields — r4 frames must not resume
-                "sharded_device_r5",
+                # the checkpoint fields — r4 frames must not resume.
+                # r6: fpset mode stores full hash-table columns instead
+                # of sorted prefixes; sort-mode frames keep the r5 sig
+                # so they remain resumable under -visited sort
+                "sharded_device_r5"
+                if self.visited_impl == "sort"
+                else "sharded_device_r6_fpset",
             )
         )
 
@@ -1241,15 +1323,25 @@ class ShardedDeviceChecker:
         mk = int(nkeys.max())  # owner-side key counts size the vk slice
         W = self.W
         tmp = self.checkpoint_path + ".tmp.npz"
+        if self.visited_impl == "fpset":
+            # hash-table occupancy is scattered, so the full columns
+            # are snapshotted (npz-compression collapses the SENTINEL
+            # runs); sort mode keeps the compact mk-prefix slice
+            vk_arrays = {
+                f"vk{i}": np.asarray(col)
+                for i, col in enumerate(bufs["vk"])
+            }
+        else:
+            vk_arrays = {
+                f"vk{i}": np.asarray(col[:, :mk])
+                for i, col in enumerate(bufs["vk"])
+            }
         np.savez_compressed(
             tmp,
             sig=np.frombuffer(
                 self._config_sig().encode(), dtype=np.uint8
             ),
-            **{
-                f"vk{i}": np.asarray(col[:, :mk])
-                for i, col in enumerate(bufs["vk"])
-            },
+            **vk_arrays,
             rows=np.asarray(bufs["rows"][:, : mx * W]),
             parent=np.asarray(bufs["parent"][:, :mx]),
             lane=np.asarray(bufs["lane"][:, :mx]),
@@ -1299,8 +1391,14 @@ class ShardedDeviceChecker:
         # capacity planning BEFORE allocating: the next flush may add a
         # full accumulator per shard, and the store must admit one
         # append window past the restored high-water mark
-        while self.VCAP < mk + self.ACAP:
-            self.VCAP *= 2
+        if self.visited_impl == "fpset":
+            # the snapshot fixes the table tier; growth (if the resumed
+            # run needs it) goes through the regular rehash below
+            self.TCAP = int(d["vk0"].shape[1]) - 1
+            self.VCAP = self.TCAP // 2
+        else:
+            while self.VCAP < mk + self.ACAP:
+                self.VCAP *= 2
         need_l = max(mx + self.APAD, self.NCs + self.APAD)
         while self.LCAP < need_l:
             self.LCAP = min(self.LCAP * 2, need_l)
@@ -1322,12 +1420,23 @@ class ShardedDeviceChecker:
                 axis=1,
             )
 
-        bufs = {
-            "vk": tuple(
-                pad_to(f"vk{i}", self.VCAP, SENTINEL, jnp.uint32)
-                for i in range(K)
-            ),
-        }
+        if self.visited_impl == "fpset":
+            bufs = {
+                "vk": tuple(
+                    jax.device_put(
+                        np.ascontiguousarray(d[f"vk{i}"], np.uint32),
+                        sh,
+                    )
+                    for i in range(K)
+                ),
+            }
+        else:
+            bufs = {
+                "vk": tuple(
+                    pad_to(f"vk{i}", self.VCAP, SENTINEL, jnp.uint32)
+                    for i in range(K)
+                ),
+            }
         self._alloc_acc(bufs)
         bufs["rows"] = pad_to("rows", self.LCAP * W, 0, jnp.uint32)
         bufs["parent"] = pad_to("parent", self.LCAP, 0, jnp.int32)
@@ -1341,7 +1450,13 @@ class ShardedDeviceChecker:
             "dead": self._dev_fill((N,), int(BIG), jnp.int32),
             "viol": self._dev_fill((N, n_inv), int(BIG), jnp.int32),
             "ovf": self._dev_fill((N,), 0, jnp.bool_),
+            "fpm": self._dev_fill((N, 3), 0, jnp.int32),
         }
+        if self.visited_impl == "fpset":
+            # the next flush may add a full accumulator of owned keys
+            # per shard; grow (rehash) now if the snapshot tier cannot
+            # absorb that at load <= 1/2
+            self._grow_visited(bufs, mk + self.ACAP)
         return (
             bufs, st, [int(x) for x in d["level_sizes"]],
             d["lb"].astype(np.int64), d["nf"].astype(np.int64),
@@ -1378,7 +1493,7 @@ class ShardedDeviceChecker:
         bufs = {}
         self._alloc_acc(bufs)
         bufs["vk"] = tuple(
-            self._dev_fill((N, self.VCAP), SENTINEL, jnp.uint32)
+            self._dev_fill((N, self._vk_width()), SENTINEL, jnp.uint32)
             for _ in range(K)
         )
         bufs["rows"] = self._dev_fill(
@@ -1391,6 +1506,7 @@ class ShardedDeviceChecker:
         viol = self._dev_fill((N, n_inv), int(BIG), jnp.int32)
         nvis = self._dev_fill((N,), 0, jnp.int32)
         nkeys = self._dev_fill((N,), 0, jnp.int32)
+        fpm = self._dev_fill((N, 3), 0, jnp.int32)
         mark("alloc")
         out = self._init_round_jit()(
             bufs["ak"], bufs["arows"], bufs["apar"], bufs["alane"],
@@ -1420,7 +1536,7 @@ class ShardedDeviceChecker:
         mark("round")
         out = self._flush_jit()(
             bufs["vk"], bufs["ak"], bufs["aq"], bufs["aq2"], nkeys,
-            jnp.int32(0),
+            fpm, jnp.int32(0),
         )
         drain(out)
         bufs["vk"] = tuple(out[0])
@@ -1431,7 +1547,7 @@ class ShardedDeviceChecker:
         )
         drain(app)
         mark("append")
-        drain(self._stats_jit()(nvis, nkeys, dead, viol, ovf))
+        drain(self._stats_jit()(nvis, nkeys, dead, viol, ovf, fpm))
         mark("misc")
         if seed_states:
             # precompile the host-seed loader's programs at the shape
@@ -1486,7 +1602,9 @@ class ShardedDeviceChecker:
             return self._run_levels(t0, bufs, st, level_sizes, lb, nf)
         bufs = {
             "vk": tuple(
-                self._dev_fill((N, self.VCAP), SENTINEL, jnp.uint32)
+                self._dev_fill(
+                    (N, self._vk_width()), SENTINEL, jnp.uint32
+                )
                 for _ in range(K)
             ),
             "rows": self._dev_fill(
@@ -1502,6 +1620,7 @@ class ShardedDeviceChecker:
             "dead": self._dev_fill((N,), int(BIG), jnp.int32),
             "viol": self._dev_fill((N, n_inv), int(BIG), jnp.int32),
             "ovf": self._dev_fill((N,), 0, jnp.bool_),
+            "fpm": self._dev_fill((N, 3), 0, jnp.int32),
         }
         self._host_wait_s = 0.0
 
@@ -1584,26 +1703,40 @@ class ShardedDeviceChecker:
     def _fetch(self, st):
         """Stats matrix columns: 0 = per-shard producer-local state
         count, 1 = per-shard owned-key count, 2 = deadlock gid, 3.. =
-        per-invariant violation gids, last = routing-overflow flag."""
+        per-invariant violation gids, then the routing-overflow flag
+        and the per-shard fpset metrics [flushes, probe rounds,
+        failures] (zeros in sort mode)."""
         tf = time.time()
         out = np.asarray(
             self._stats_jit()(
                 st["n_visited"], st["n_keys"], st["dead"], st["viol"],
-                st["ovf"],
+                st["ovf"], st["fpm"],
             )
         )
         self._host_wait_s += time.time() - tf
-        if out[:, 3 + len(self.invariant_names)].any():
+        n_inv = len(self.invariant_names)
+        if out[:, 3 + n_inv].any():
             raise _RouteOverflow
+        self._last_fpm = out[:, 4 + n_inv: 7 + n_inv]
+        if self._last_fpm[:, 2].any():
+            # probe overflow: some owner table dropped routed keys in a
+            # flush that already appended — counts can no longer be
+            # trusted, so abort hard (never a silent drop)
+            raise RuntimeError(
+                "fpset probe overflow on "
+                f"{int((self._last_fpm[:, 2] > 0).sum())} shard(s) — "
+                "raise visited_cap"
+            )
         return out
 
     def _flush(self, bufs, st, n_acc: int):
         out = self._flush_jit()(
             bufs["vk"], bufs["ak"], bufs["aq"], bufs["aq2"],
-            st["n_keys"], jnp.int32(n_acc),
+            st["n_keys"], st["fpm"], jnp.int32(n_acc),
         )
         bufs["vk"] = tuple(out[0])
         st["n_keys"], n_new, flag_local = out[1], out[2], out[3]
+        st["fpm"] = out[4]
         (
             bufs["rows"], bufs["parent"], bufs["lane"],
             st["n_visited"], st["viol"],
@@ -1907,6 +2040,19 @@ class ShardedDeviceChecker:
         self.last_stats_matrix = stats
         wall = time.time() - t0
         nv = int(stats[:, 0].sum())
+        if self.visited_impl == "fpset" and self._last_fpm is not None:
+            fl = int(self._last_fpm[:, 0].sum())
+            rd = int(self._last_fpm[:, 1].sum())
+            self.last_stats.update(
+                fpset_flushes=fl,
+                fpset_probe_rounds=rd,
+                fpset_avg_probe_rounds=round(rd / max(fl, 1), 2),
+                fpset_failures=int(self._last_fpm[:, 2].sum()),
+                fpset_table_cap=self.TCAP,
+                fpset_max_occupancy=round(
+                    float(stats[:, 1].max()) / max(self.TCAP, 1), 4
+                ),
+            )
         res = CheckerResult(
             distinct_states=nv,
             diameter=len(level_sizes),

@@ -1,0 +1,415 @@
+"""Device-resident growable FPSet — the hash-table visited set that
+retires the flush's visited-width sort-merge (round 6 tentpole).
+
+Why a table, and why now.  The round-5 per-stage split (BASELINE.md)
+showed the flush — three full-width sorts of up to 203M keys per
+26.7M-candidate accumulator — at ~50% of stage time: a per-candidate
+cost that GROWS with the visited set.  An HBM-resident open-addressing
+table makes dedup O(batch * E[probes]) independent of how many states
+have been visited — the frontier-expansion shape tensor-core BFS work
+(BLEST, arxiv 2512.21967; Graph Traversal on Tensor Cores, arxiv
+2606.05081) gets its throughput from.  BASELINE.md's own crossover
+estimate ("wins once the visited set is >= 2x the 78M-key tier") is the
+sizing argument; this module is the `ops/hashtable.py` triangular-
+probing design generalised to the device hot path:
+
+- **K key columns** (2 or 3 uint32 words, straight from
+  :class:`~pulsar_tlaplus_tpu.ops.dedup.KeySpec`) instead of the fixed
+  3+occupancy layout — the all-SENTINEL tuple is the empty marker
+  (KeySpec reserves it), so no occupancy column and one fewer scatter
+  per insert round.
+- **Staged pending compaction.**  The probe loop's dense per-round cost
+  is O(nq) random accesses whether one lane is pending or all are; the
+  expected MAX probe count over millions of lanes is ~log2(nq) /
+  log2(1/load) rounds, so a single monolithic loop pays ~20+ dense
+  rounds for a tail that involves a few thousand lanes (this is what
+  kept the table off the hot path in rounds 3-5).  ``lookup_or_insert``
+  runs a few dense rounds, then compacts the surviving pending lanes
+  (one single-key sort, the `compact_by_flag` idiom) into a 4x-smaller
+  buffer, probes on, compacts again into a 16x-smaller buffer — the
+  tail rounds cost 1/4 and 1/16 of a dense round.  At load <= 1/2 the
+  expected pending fraction after r rounds is ~2^-r, so the static
+  stage capacities carry 2-8x safety margins; a lane that overflows a
+  stage is counted in ``n_failed`` and the engine fails LOUDLY (the
+  same fail-stop contract as `ops/hashtable.py`), never a silent drop.
+- **Deterministic discovery order.**  Equal-key lanes resolve to the
+  minimum lane id (scatter-min bidding; compaction is order-preserving
+  and stages bid with original lane ids), which is exactly the
+  sort-merge flush's "lowest accumulator slot wins" — the fpset-backed
+  engine assigns the SAME gids as the legacy flush, state for state.
+- **On-device growth**: :func:`rehash_cols` re-inserts every occupied
+  slot of the old table into a double-size table with a `fori_loop` of
+  chunked probe rounds — one dispatch, no host staging, and the
+  transient is old+new table (far below the retired flush sort's
+  3x-visited-width transients).
+
+Load factor is the caller's contract: engines grow before the table
+exceeds 1/2 (`ops/hashtable.py`'s regime), which bounds expected probes
+per lane at ~2 and makes stage overflow astronomically unlikely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, _fmix
+
+MAX_PROBES = 64
+# staged-compaction schedule for the engine hot path: a few dense
+# rounds, then (shrink divisor, probe-round limit) per stage.  At load
+# <= 1/2 the expected pending fraction entering stage i is ~2^-rounds,
+# well under 1/divisor (see module docstring).
+DENSE_ROUNDS = 4
+STAGES = ((4, 16), (16, MAX_PROBES))
+# stage-capacity floor: the 1/div shrink is a concentration argument
+# that only holds for large batches (binomial tail at nq/16 expected
+# pending vs nq/4 capacity).  Small batches get the full width — for
+# nq below the floor the stages run in place, where overflow is
+# impossible and compaction would save nothing anyway.
+MIN_STAGE = 1 << 10
+
+_NO_LANE = jnp.int32(2**31 - 1)  # claims fill: above every real lane id
+
+
+def slot_hash(kcols: Tuple[jax.Array, ...]) -> jax.Array:
+    """Mix K key columns into a table-index basis (u32).  Exact keys
+    are raw state words with heavily skewed low bits; the fmix chain
+    spreads them (identical to ``hashtable._slot_hash`` for K=3, so the
+    shim below stays layout-compatible)."""
+    h = _fmix(kcols[0] ^ jnp.uint32(0x9E3779B9))
+    for c in kcols[1:]:
+        h = _fmix(h ^ c)
+    return h
+
+
+def empty_cols(cap: int, ncols: int) -> Tuple[jax.Array, ...]:
+    """K SENTINEL-filled uint32 columns of ``cap + 1`` slots for a
+    power-of-two ``cap``.  Slot ``cap`` is the write-only trash row
+    that parked lanes scatter into (keeps every scatter dense)."""
+    if cap & (cap - 1):
+        raise ValueError(f"table capacity must be a power of two: {cap}")
+    return tuple(
+        jnp.full((cap + 1,), SENTINEL, jnp.uint32) for _ in range(ncols)
+    )
+
+
+def occupied_mask(tcols: Tuple[jax.Array, ...]) -> jax.Array:
+    """bool[cap] — occupied (non-all-SENTINEL) slots, trash row
+    excluded.  Used by rehash and checkpoint extraction."""
+    cap = tcols[0].shape[0] - 1
+    e = tcols[0][:cap] == SENTINEL
+    for c in tcols[1:]:
+        e = e & (c[:cap] == SENTINEL)
+    return ~e
+
+
+def all_sentinel(cols) -> jax.Array:
+    e = cols[0] == SENTINEL
+    for c in cols[1:]:
+        e = e & (c == SENTINEL)
+    return e
+
+
+def probe_insert(
+    tcols: Tuple[jax.Array, ...],
+    kcols: Tuple[jax.Array, ...],
+    valid: jax.Array,
+    occ: Optional[jax.Array] = None,
+    max_probes: int = MAX_PROBES,
+    start_round: int | jax.Array = 0,
+    lane_ids: Optional[jax.Array] = None,
+):
+    """One batched triangular-probing lookup-or-insert loop.
+
+    Probe round r inspects slot ``(h + r(r+1)/2) & (cap-1)`` (covers
+    every slot when cap is a power of two); lanes seeing their key
+    resolve as duplicates; lanes seeing an empty slot bid for it with a
+    scatter-min of their lane id (the unique winner writes its key, and
+    same-key losers resolve against the freshly written slot).
+
+    ``occ`` selects the empty-slot encoding: ``None`` = all-SENTINEL
+    key (the engines' layout), else an explicit occupancy column (the
+    ``ops.hashtable`` compatibility layout).  ``start_round`` /
+    ``lane_ids`` let the staged wrapper resume the probe sequence on a
+    compacted buffer while bidding with ORIGINAL lane ids (preserving
+    min-lane-wins — the sort-merge flush's discovery order).
+
+    Returns ``(is_new, tcols', occ', pending, rounds)``; ``pending``
+    lanes are unresolved after ``max_probes`` rounds (callers count
+    them as hard failures, never silent drops).
+    """
+    cap = tcols[0].shape[0] - 1
+    nq = kcols[0].shape[0]
+    if lane_ids is None:
+        lane_ids = jnp.arange(nq, dtype=jnp.int32)
+    h = slot_hash(kcols)
+    capm = jnp.uint32(cap - 1)
+    has_occ = occ is not None
+    occ0 = occ if has_occ else jnp.zeros((0,), jnp.int32)
+
+    def occupied_at(tc, oc, s, sv):
+        if has_occ:
+            return oc[s] == 1
+        return ~all_sentinel(sv)
+
+    def cond(st):
+        r, pending = st[0], st[1]
+        return (r < max_probes) & jnp.any(pending)
+
+    def body(st):
+        r, pending, is_new, tc, oc = st
+        ru = r.astype(jnp.uint32)
+        off = (ru * (ru + jnp.uint32(1))) >> 1
+        slot = ((h + off) & capm).astype(jnp.int32)
+        s = jnp.where(pending, slot, cap)  # parked lanes hit the trash row
+        sv = tuple(c[s] for c in tc)
+        occ_s = occupied_at(tc, oc, s, sv)
+        eq = sv[0] == kcols[0]
+        for cv, ck in zip(sv[1:], kcols[1:]):
+            eq = eq & (cv == ck)
+        found = pending & occ_s & eq
+        pending = pending & ~found
+        # bid for empty slots with the lane id; min wins
+        bid = pending & ~occ_s
+        bid_slot = jnp.where(bid, s, cap)
+        claims = jnp.full((cap + 1,), _NO_LANE, jnp.int32).at[
+            bid_slot
+        ].min(lane_ids)
+        win = bid & (claims[s] == lane_ids)
+        ws = jnp.where(win, s, cap)
+        tc = tuple(c.at[ws].set(k) for c, k in zip(tc, kcols))
+        if has_occ:
+            oc = oc.at[ws].set(1)
+        is_new = is_new | win
+        pending = pending & ~win
+        # same-key losers resolve against the newly written slot
+        sv2 = tuple(c[s] for c in tc)
+        eq2 = sv2[0] == kcols[0]
+        for cv, ck in zip(sv2[1:], kcols[1:]):
+            eq2 = eq2 & (cv == ck)
+        occ2 = occupied_at(tc, oc, s, sv2)
+        pending = pending & ~(occ2 & eq2)
+        return (r + 1, pending, is_new, tc, oc)
+
+    st = (
+        jnp.asarray(start_round, jnp.int32),
+        valid,
+        jnp.zeros((nq,), jnp.bool_),
+        tuple(tcols),
+        occ0,
+    )
+    r, pending, is_new, tcols, occ_out = lax.while_loop(cond, body, st)
+    return is_new, tcols, (occ_out if has_occ else None), pending, r
+
+
+def lookup_or_insert(
+    tcols: Tuple[jax.Array, ...],
+    kcols: Tuple[jax.Array, ...],
+    valid: jax.Array,
+    max_probes: int = MAX_PROBES,
+    dense_rounds: int = DENSE_ROUNDS,
+    stages=STAGES,
+):
+    """Engine hot path: staged batched lookup-or-insert (see module
+    docstring for the why of the stages).
+
+    Returns ``(is_new, tcols', n_failed, rounds)`` where ``is_new`` is
+    in ORIGINAL lane order (exactly one True per distinct new key — the
+    minimum valid lane), ``n_failed`` counts lanes dropped at a stage
+    overflow or still pending at ``max_probes`` (callers treat nonzero
+    as a hard error), and ``rounds`` is the probe rounds consumed (the
+    per-flush probe metric).
+    """
+    nq = kcols[0].shape[0]
+    K = len(kcols)
+    is_new, tcols, _, pending, r = probe_insert(
+        tcols, kcols, valid, max_probes=min(dense_rounds, max_probes)
+    )
+    n_failed = jnp.int32(0)
+    cur_keys, cur_ids, cur_pending = kcols, None, pending
+    for div, limit in stages:
+        limit = min(limit, max_probes)
+        capi = max(nq // div, min(nq, MIN_STAGE))
+        if capi >= nq or limit <= dense_rounds:
+            # no shrink to be had (tiny batches): just probe on in place
+            is_new2, tcols, _, cur_pending, r = probe_insert(
+                tcols, cur_keys, cur_pending, max_probes=limit,
+                start_round=r, lane_ids=cur_ids,
+            )
+            is_new = _merge_new(is_new, is_new2, cur_ids, nq)
+            continue
+        # order-preserving compaction of the pending lanes (+ their
+        # original lane ids) into the 1/div-size stage buffer
+        ids = (
+            cur_ids
+            if cur_ids is not None
+            else jnp.arange(nq, dtype=jnp.int32)
+        )
+        drop = (~cur_pending).astype(jnp.uint32)
+        ccols, _ = dedup.compact_by_flag(
+            drop, tuple(cur_keys) + (ids.astype(jnp.uint32),)
+        )
+        npend = jnp.sum(cur_pending.astype(jnp.int32))
+        n_failed = n_failed + jnp.maximum(npend - capi, 0)
+        cur_keys = tuple(c[:capi] for c in ccols[:K])
+        cur_ids = ccols[K][:capi].astype(jnp.int32)
+        cur_pending = jnp.arange(capi, dtype=jnp.int32) < npend
+        is_new2, tcols, _, cur_pending, r = probe_insert(
+            tcols, cur_keys, cur_pending, max_probes=limit,
+            start_round=r, lane_ids=cur_ids,
+        )
+        is_new = _merge_new(is_new, is_new2, cur_ids, nq)
+    n_failed = n_failed + jnp.sum(cur_pending.astype(jnp.int32))
+    return is_new, tcols, n_failed, r
+
+
+def _merge_new(is_new, stage_new, stage_ids, nq):
+    """Scatter a stage's winner flags back to original lane order
+    (only True flags are written — resolved lanes keep their bits)."""
+    if stage_ids is None:
+        return is_new | stage_new
+    tgt = jnp.where(stage_new, stage_ids, nq)
+    return is_new.at[tgt].set(True, mode="drop")
+
+
+def lookup(
+    tcols: Tuple[jax.Array, ...],
+    kcols: Tuple[jax.Array, ...],
+    valid: jax.Array,
+    max_probes: int = MAX_PROBES,
+):
+    """Read-only membership probe: bool[nq] (True = key present).
+    Lanes resolve on their key (member) or the first empty slot in
+    their probe sequence (non-member)."""
+    cap = tcols[0].shape[0] - 1
+    nq = kcols[0].shape[0]
+    h = slot_hash(kcols)
+    capm = jnp.uint32(cap - 1)
+
+    def cond(st):
+        r, pending = st[0], st[1]
+        return (r < max_probes) & jnp.any(pending)
+
+    def body(st):
+        r, pending, member = st
+        ru = r.astype(jnp.uint32)
+        off = (ru * (ru + jnp.uint32(1))) >> 1
+        s = jnp.where(
+            pending, ((h + off) & capm).astype(jnp.int32), cap
+        )
+        sv = tuple(c[s] for c in tcols)
+        empty = all_sentinel(sv)
+        eq = sv[0] == kcols[0]
+        for cv, ck in zip(sv[1:], kcols[1:]):
+            eq = eq & (cv == ck)
+        member = member | (pending & ~empty & eq)
+        pending = pending & ~empty & ~eq
+        return (r + 1, pending, member)
+
+    _, _, member = lax.while_loop(
+        cond, body, (jnp.int32(0), valid, jnp.zeros((nq,), jnp.bool_))
+    )
+    return member
+
+
+def rehash_cols(
+    old_cols: Tuple[jax.Array, ...],
+    new_cols: Tuple[jax.Array, ...],
+    chunk: int = 1 << 16,
+    max_probes: int = MAX_PROBES,
+):
+    """Re-insert every occupied slot of ``old_cols`` into the (larger)
+    ``new_cols`` — fully on device (one `fori_loop` of chunked probe
+    rounds), so it is usable inside jit and shard_map bodies alike.
+
+    Returns ``(new_cols, n_failed)``; the keys are distinct by
+    construction and the post-growth load is <= 1/4, so a nonzero
+    failure count means the caller's capacity contract was broken
+    (fail-stop upstream, like every other capacity violation here).
+    """
+    ocap = old_cols[0].shape[0] - 1
+    chunk = min(chunk, ocap)
+    if ocap % chunk:
+        raise ValueError("rehash chunk must divide the old capacity")
+
+    def body(i, carry):
+        new, failed = carry
+        ks = tuple(
+            lax.dynamic_slice(c, (i * chunk,), (chunk,))
+            for c in old_cols
+        )
+        occm = ~all_sentinel(ks)
+        _new_flags, new, _, pending, _r = probe_insert(
+            new, ks, occm, max_probes=max_probes
+        )
+        return new, failed + jnp.sum(pending.astype(jnp.int32))
+
+    new_cols, n_failed = lax.fori_loop(
+        0, ocap // chunk, body, (tuple(new_cols), jnp.int32(0))
+    )
+    return new_cols, n_failed
+
+
+class FPSet:
+    """Host-side convenience wrapper (tests, probes, host-loop engines):
+    owns the column tuple, the entry count, growth, and cumulative
+    probe/occupancy/failure metrics.  The device engines inline the
+    functional core above in their own jitted programs instead."""
+
+    def __init__(self, ncols: int, cap: int = 1 << 10):
+        self.cols = empty_cols(cap, ncols)
+        self.ncols = ncols
+        self.n = 0
+        self.stats = {"inserts": 0, "probe_rounds": 0, "failures": 0}
+
+    @property
+    def cap(self) -> int:
+        return self.cols[0].shape[0] - 1
+
+    @property
+    def occupancy(self) -> float:
+        return self.n / self.cap
+
+    def reserve(self, n_entries: int):
+        """Grow (double + on-device rehash) until ``n_entries`` fit at
+        load factor <= 1/2."""
+        while 2 * n_entries > self.cap:
+            new = empty_cols(self.cap * 2, self.ncols)
+            self.cols, failed = rehash_cols(self.cols, new)
+            if int(failed):
+                raise RuntimeError("fpset rehash overflow")
+        return self
+
+    def insert(self, kcols, valid=None):
+        """Batched insert; returns the is_new bool vector (lane order).
+        Grows first so the load-factor contract always holds."""
+        kcols = tuple(jnp.asarray(c, jnp.uint32) for c in kcols)
+        nq = kcols[0].shape[0]
+        if valid is None:
+            valid = jnp.ones((nq,), jnp.bool_)
+        self.reserve(self.n + nq)
+        is_new, self.cols, n_failed, rounds = lookup_or_insert(
+            self.cols, kcols, valid
+        )
+        nf = int(n_failed)
+        self.n += int(jnp.sum(is_new.astype(jnp.int32)))
+        self.stats["inserts"] += 1
+        self.stats["probe_rounds"] += int(rounds)
+        self.stats["failures"] += nf
+        if nf:
+            raise RuntimeError(
+                f"fpset probe overflow ({nf} lanes unresolved) — "
+                "grow the table before exceeding load factor 1/2"
+            )
+        return is_new
+
+    def contains(self, kcols, valid=None):
+        kcols = tuple(jnp.asarray(c, jnp.uint32) for c in kcols)
+        if valid is None:
+            valid = jnp.ones((kcols[0].shape[0],), jnp.bool_)
+        return lookup(self.cols, kcols, valid)

@@ -1,33 +1,21 @@
-"""Open-addressing visited-set hash table in HBM — the TPU-native FPSet
-(SURVEY.md §2.2-E3, §7-L3).
+"""Open-addressing visited-set hash table in HBM (SURVEY.md §2.2-E3,
+§7-L3) — now a thin compatibility layer over :mod:`.fpset`.
 
-Replaces the v0 sorted-columns + binary-search + full-merge design: a
-merge re-sorts the ENTIRE visited set every chunk (O(cap log cap)), while
-table probes cost O(batch * E[probes]) independent of how many states have
-been visited — the difference between a per-step cost that grows with the
-run and one that stays flat.
+Round 6 promoted this design to the device hot path as the growable,
+K-column, staged-compaction FPSet in ``ops/fpset.py`` (see its module
+docstring for the probing/bidding algorithm and the discovery-order
+guarantee).  The host-loop engines (``engine/core.py``,
+``engine/bfs.py``, ``engine/sharded.py``) keep this module's original
+fixed 3-column + occupancy-column API; the probe loop itself lives in
+``fpset.probe_insert`` so there is exactly one implementation of
+triangular probing and scatter-min bidding in the repo.
 
-Layout: four uint32[cap + 1] columns — three key words (the 96-bit exact
-or hashed dedup key from :mod:`.dedup`) plus an occupancy column.  ``cap``
-is a power of two; slot ``cap`` is a write-only trash row that lanes
-without work scatter into (keeps every scatter dense and branch-free).
-
-Batched lookup-or-insert resolves races entirely on device:
-
-1. probe round r inspects slot ``(h + r(r+1)/2) & (cap-1)`` (triangular
-   probing — covers every slot when cap is a power of two);
-2. lanes whose key already sits in the slot resolve as duplicates;
-3. lanes seeing an empty slot bid for it with a scatter-min of their lane
-   id; the unique winner writes its key (scatter-set, winner slots are
-   distinct by construction);
-4. losers re-read the slot: if the winner had the SAME key they resolve
-   as duplicates, otherwise they continue to the next round.
-
-The loop is a ``lax.while_loop`` — typical batches resolve in 2-4 rounds
-at load factor <= 1/2 (the engine grows the table before exceeding it).
-Lanes still pending after ``max_probes`` rounds are reported in the
-returned failure count; the caller treats that as a hard error rather
-than silently dropping states (probability ~ load^max_probes per lane).
+Layout: four uint32[cap + 1] columns — three key words plus an
+occupancy column.  ``cap`` is a power of two; slot ``cap`` is the
+write-only trash row that parked lanes scatter into.  Batched
+lookup-or-insert resolves races entirely on device; lanes still pending
+after ``max_probes`` rounds are reported in the returned failure count
+(callers treat nonzero as a hard error, never a silent drop).
 """
 
 from __future__ import annotations
@@ -37,9 +25,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from pulsar_tlaplus_tpu.ops.dedup import _fmix
+from pulsar_tlaplus_tpu.ops import fpset
 
-MAX_PROBES = 64
+MAX_PROBES = fpset.MAX_PROBES
 
 
 def empty_table(cap: int) -> Tuple[jax.Array, ...]:
@@ -52,9 +40,7 @@ def empty_table(cap: int) -> Tuple[jax.Array, ...]:
 
 def _slot_hash(k1: jax.Array, k2: jax.Array, k3: jax.Array) -> jax.Array:
     """Mix the three key words into a table index basis (u32)."""
-    h = _fmix(k1 ^ jnp.uint32(0x9E3779B9))
-    h = _fmix(h ^ k2)
-    return _fmix(h ^ k3)
+    return fpset.slot_hash((k1, k2, k3))
 
 
 def lookup_insert(
@@ -72,56 +58,14 @@ def lookup_insert(
 
     Returns ``(is_new, t1', t2', t3', occ', n_failed)`` where ``is_new[i]``
     is True iff lane i's key was absent and this call inserted it (exactly
-    one lane wins per distinct new key), and ``n_failed`` counts lanes
-    still unresolved after ``max_probes`` rounds (callers must treat
-    nonzero as an error — see module docstring).
+    one lane wins per distinct new key — the minimum lane id), and
+    ``n_failed`` counts lanes still unresolved after ``max_probes`` rounds
+    (callers must treat nonzero as an error — see module docstring).
     """
-    cap = t1.shape[0] - 1
-    nq = k1.shape[0]
-    lane = jnp.arange(nq, dtype=jnp.int32)
-    h = _slot_hash(k1, k2, k3)
-    capm = jnp.uint32(cap - 1)
-
-    def cond(st):
-        r, pending, _is_new, _t1, _t2, _t3, _occ = st
-        return (r < max_probes) & jnp.any(pending)
-
-    def body(st):
-        r, pending, is_new, t1, t2, t3, occ = st
-        # triangular probe: slot_r = h + r(r+1)/2 (mod cap)
-        off = (r.astype(jnp.uint32) * (r.astype(jnp.uint32) + 1)) >> 1
-        slot = ((h + off) & capm).astype(jnp.int32)
-        s = jnp.where(pending, slot, cap)  # parked lanes hit the trash row
-        o = occ[s]
-        eq = (t1[s] == k1) & (t2[s] == k2) & (t3[s] == k3)
-        found = pending & (o == 1) & eq
-        pending = pending & ~found
-        # bid for empty slots with lane id; min wins
-        bid_slot = jnp.where(pending & (o == 0), s, cap)
-        claims = jnp.full((cap + 1,), nq, jnp.int32).at[bid_slot].min(lane)
-        win = pending & (o == 0) & (claims[s] == lane)
-        ws = jnp.where(win, s, cap)
-        t1 = t1.at[ws].set(k1)
-        t2 = t2.at[ws].set(k2)
-        t3 = t3.at[ws].set(k3)
-        occ = occ.at[ws].set(1)
-        is_new = is_new | win
-        pending = pending & ~win
-        # same-key losers resolve against the newly written slot
-        eq2 = (t1[s] == k1) & (t2[s] == k2) & (t3[s] == k3)
-        pending = pending & ~((occ[s] == 1) & eq2)
-        return r + 1, pending, is_new, t1, t2, t3, occ
-
-    st = (
-        jnp.int32(0),
-        valid,
-        jnp.zeros((nq,), jnp.bool_),
-        t1,
-        t2,
-        t3,
-        occ,
+    is_new, (t1, t2, t3), occ, pending, _rounds = fpset.probe_insert(
+        (t1, t2, t3), (k1, k2, k3), valid, occ=occ,
+        max_probes=max_probes,
     )
-    _r, pending, is_new, t1, t2, t3, occ = jax.lax.while_loop(cond, body, st)
     return is_new, t1, t2, t3, occ, jnp.sum(pending.astype(jnp.int32))
 
 

@@ -26,6 +26,17 @@ Robustness: serialize/deserialize failures fall back to the normal
 jit path (the cache is an optimization, never a correctness
 dependency), and a deserialized executable is verified by its first
 call — a runtime rejection recompiles in-process.
+
+Hardening (ADVICE r5): the cache entries are pickles, and unpickling
+attacker-controlled bytes executes arbitrary code.  So (a) the cache
+directory is created 0o700 and the cache refuses to load OR store when
+the directory is owned by another uid or writable by group/other,
+(b) every entry embeds a SHA-256 digest of the pickled payload that is
+verified BEFORE unpickling (rejects truncation/corruption and casual
+tampering), and (c) the cache key folds in compile-affecting
+environment (``XLA_FLAGS``, ``LIBTPU_INIT_ARGS``, ``JAX_ENABLE_X64``)
+so changing those between runs can never load a stale executable
+compiled under different options.
 """
 
 from __future__ import annotations
@@ -37,11 +48,51 @@ from typing import Any, Dict, Optional
 
 import jax
 
+# entry layout: magic + sha256(payload) + payload (a pickled
+# (serialized_executable, in_tree, out_tree) tuple).  Bump the magic on
+# any format change — old entries then fail verification and recompile.
+_MAGIC = b"PTTAOTX2"
+
+# compile-affecting environment folded into the cache key (ADVICE r5:
+# XLA_FLAGS changes must never load a stale executable)
+_COMPILE_ENV = ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "JAX_ENABLE_X64")
+
 
 def _cache_dir() -> str:
     return os.environ.get(
         "PTT_AOT_DIR", os.path.expanduser("~/.ptt_aot_cache")
     )
+
+
+_DIR_TRUSTED: Optional[bool] = None
+
+
+def _dir_trusted() -> bool:
+    """Create the cache dir 0o700 and verify it is exclusively ours
+    (owned by this uid, not group/other-writable) before any pickle
+    crosses it.  Resolved once per process; an untrusted directory
+    disables the cache (one stderr note), it never raises."""
+    global _DIR_TRUSTED
+    if _DIR_TRUSTED is not None:
+        return _DIR_TRUSTED
+    d = _cache_dir()
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        stat = os.stat(d)
+        uid_ok = not hasattr(os, "getuid") or stat.st_uid == os.getuid()
+        _DIR_TRUSTED = bool(uid_ok and not (stat.st_mode & 0o022))
+    except OSError:
+        _DIR_TRUSTED = False
+    if not _DIR_TRUSTED:
+        import sys
+
+        print(
+            f"note: AOT executable cache disabled: {d!r} is not an "
+            "exclusively-owned 0o700 directory (loading pickled "
+            "executables from a shared directory would be unsafe)",
+            file=sys.stderr,
+        )
+    return _DIR_TRUSTED
 
 
 _ENABLED: Optional[bool] = None
@@ -72,6 +123,11 @@ def _key_of(lowered) -> str:
     h = hashlib.sha256()
     h.update(lowered.as_text().encode())
     h.update(jax.__version__.encode())
+    for name in _COMPILE_ENV:
+        # compile-affecting env must shape the key: two processes with
+        # different XLA_FLAGS would otherwise share entries and the
+        # second would silently run under the first one's options
+        h.update(f"{name}={os.environ.get(name, '')}\x00".encode())
     try:
         import jaxlib
 
@@ -94,7 +150,16 @@ def _load(path: str):
     from jax.experimental import serialize_executable as se
 
     with open(path, "rb") as fh:
-        payload, in_tree, out_tree = pickle.load(fh)
+        raw = fh.read()
+    hlen = len(_MAGIC) + 32
+    if len(raw) < hlen or not raw.startswith(_MAGIC):
+        raise ValueError("unrecognized AOT cache entry format")
+    digest, blob = raw[len(_MAGIC): hlen], raw[hlen:]
+    # verify BEFORE unpickling: a truncated/corrupted/tampered entry
+    # must never reach pickle.loads (see module docstring)
+    if hashlib.sha256(blob).digest() != digest:
+        raise ValueError("AOT cache entry failed digest verification")
+    payload, in_tree, out_tree = pickle.loads(blob)
     return se.deserialize_and_load(payload, in_tree, out_tree)
 
 
@@ -102,10 +167,13 @@ def _store(path: str, compiled) -> None:
     from jax.experimental import serialize_executable as se
 
     payload, in_tree, out_tree = se.serialize(compiled)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    blob = pickle.dumps((payload, in_tree, out_tree))
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as fh:
-        pickle.dump((payload, in_tree, out_tree), fh)
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(_MAGIC)
+        fh.write(hashlib.sha256(blob).digest())
+        fh.write(blob)
     os.replace(tmp, path)  # atomic vs concurrent writers
 
 
@@ -142,9 +210,10 @@ class _AJit:
 
     def _build(self, sig, args):
         lowered = self._jit.lower(*args)
+        trusted = _dir_trusted()
         key = _key_of(lowered)
         path = os.path.join(_cache_dir(), f"{key}.aotx")
-        if os.path.exists(path):
+        if trusted and os.path.exists(path):
             try:
                 comp = _load(path)
                 self.events[sig] = "hit"
@@ -155,10 +224,11 @@ class _AJit:
         comp = lowered.compile()
         self.events[sig] = "compile"
         comp._ptt_verified = True  # freshly compiled, nothing to verify
-        try:
-            _store(path, comp)
-        except Exception:  # noqa: BLE001
-            pass  # serialization unsupported: still usable in-process
+        if trusted:
+            try:
+                _store(path, comp)
+            except Exception:  # noqa: BLE001
+                pass  # serialization unsupported: still usable in-process
         return comp
 
     def __call__(self, *args):
