@@ -220,6 +220,22 @@ def parse_args(argv=None):
         "default) or sort (legacy sort-merge flush, kept for "
         "differential timing)",
     )
+    ap.add_argument(
+        "--checkpoint", default=None,
+        help="write level-boundary checkpoint frames to this .npz "
+        "(survivable bench runs: SIGTERM/SIGINT exit resumably, HBM "
+        "exhaustion recovers from the last frame instead of "
+        "truncating)",
+    )
+    ap.add_argument(
+        "--checkpoint-every", type=int, default=2,
+        help="levels between checkpoint frames (with --checkpoint)",
+    )
+    ap.add_argument(
+        "--recover", action="store_true",
+        help="resume the device run from --checkpoint instead of "
+        "starting fresh (skips the host seed)",
+    )
     return ap.parse_args(argv)
 
 
@@ -263,9 +279,18 @@ def main(argv=None):
         progress=True,
         metrics_path=metrics_path,
         visited_impl=args.visited,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
         **kw,
     )
     t0 = time.time()
+    if args.recover:
+        # resume from the frame: no host seed (the frame IS the warm
+        # start), warmup still hides the compiles
+        compile_s = ck.warmup(seed=False)
+        print(f"compile warmup: {compile_s:.1f}s", file=sys.stderr)
+        r = ck.run(resume=True)
+        return _emit(args, ck, c, r, compile_s, metrics_path)
     # the host-seeded warm start: the round-3 run spent its first ~10 s
     # producing 0.6M of its 32M states (tiny early levels pay
     # full-width sort latency + tunnel RTTs); the Python oracle
@@ -302,6 +327,10 @@ def main(argv=None):
         file=sys.stderr,
     )
     r = ck.run(seed=seed)
+    return _emit(args, ck, c, r, compile_s, metrics_path)
+
+
+def _emit(args, ck, c, r, compile_s, metrics_path):
     # CPU baselines AFTER the device run: XLA compiles run in a LOCAL
     # helper subprocess (the round-4 try that measured them during
     # warmup saw the native baseline halved by CPU contention on this
@@ -379,7 +408,15 @@ def main(argv=None):
                 "compile_breakdown_s": ck.last_stats,
                 "levels": r.diameter,
                 "distinct_states": r.distinct_states,
+                # survivability telemetry (ISSUE r7): the r06+
+                # trajectory captures whether the run survived, not
+                # just how fast it went
                 "stop_reason": r.stop_reason,
+                "truncated": r.truncated,
+                "hbm_recovered": getattr(r, "hbm_recovered", 0),
+                "ckpt_frames": ck.last_stats.get("ckpt_frames", 0),
+                "ckpt_bytes": ck.last_stats.get("ckpt_bytes", 0),
+                "checkpoint": args.checkpoint,
                 "sustained_last_level_sps": (
                     round(last_level_sps, 1)
                     if last_level_sps is not None else None

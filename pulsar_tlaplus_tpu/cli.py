@@ -26,7 +26,7 @@ def _positive_or_tpu(v: str):
     return v if v == "tpu" else int(v)
 
 
-def _report(r, constants, wall: float) -> int:
+def _report(r, constants, wall: float, checkpoint=None) -> int:
     """TLC-style result report shared by the compiled and interpreter
     paths; returns the process exit code (0 ok, 1 violation/deadlock,
     3 truncated — a truncated search is NOT a verification result)."""
@@ -69,13 +69,37 @@ def _report(r, constants, wall: float) -> int:
             "The calculated (optimistic) probability of a fingerprint "
             f"collision at this state count is {fp_p:.3g}."
         )
+    hbm_rec = getattr(r, "hbm_recovered", 0)
+    if hbm_rec:
+        print(
+            f"Note: recovered from device-memory exhaustion {hbm_rec} "
+            "time(s) by rebuilding from the checkpoint at degraded "
+            "capacity."
+        )
     if r.violation or r.deadlock:
         return 1
     if getattr(r, "truncated", False):
-        print(
-            "WARNING: search truncated by the state/time budget — the state "
-            "space was NOT exhausted; absence of violations is inconclusive."
-        )
+        reason = getattr(r, "stop_reason", None)
+        if reason == "preempted":
+            if checkpoint and os.path.exists(checkpoint):
+                print(
+                    "WARNING: search preempted (SIGTERM/SIGINT) — a "
+                    "resumable checkpoint frame is on disk; continue "
+                    "with -recover."
+                )
+            else:
+                print(
+                    "WARNING: search preempted (SIGTERM/SIGINT) before "
+                    "any checkpoint frame could be written — the run "
+                    "is NOT resumable."
+                )
+        else:
+            print(
+                "WARNING: search truncated by the state/time budget — the "
+                "state space was NOT exhausted; absence of violations is "
+                "inconclusive."
+                + (f" (stop reason: {reason})" if reason else "")
+            )
         return 3
     return 0
 
@@ -342,6 +366,25 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             metrics_path=args.metrics,
             checkpoint_path=args.checkpoint,
         )
+    elif args.engine == "device":
+        # the flagship single-chip engine (the one every BENCH runs) —
+        # with full -checkpoint/-recover survivability (round 7; TLC's
+        # states/ directory contract on the device-resident path)
+        from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+        ck = DeviceChecker(
+            model,
+            invariants=invariants,
+            check_deadlock=not args.nodeadlock,
+            sub_batch=min(args.chunk, 4096),
+            visited_cap=1 << 16,
+            frontier_cap=1 << 14,
+            max_states=args.maxstates,
+            progress=True,
+            metrics_path=args.metrics,
+            visited_impl=args.visited,
+            checkpoint_path=args.checkpoint,
+        )
     else:
         from pulsar_tlaplus_tpu.engine.bfs import Checker
 
@@ -365,8 +408,22 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
     try:
         r = ck.run(resume=args.recover)
     except (ValueError, RuntimeError) as e:
-        sys.exit(f"tpu-tlc: {e}")
-    rc = _report(r, constants, time.time() - t0)
+        msg = str(e)
+        if (
+            args.recover
+            and not args.sharded
+            and args.engine == "device"
+            and "written by a different" in msg
+        ):
+            # the r7 engine-default switch: frames from the pre-r7
+            # default (the host engine) carry a different signature —
+            # point the operator at the engine that wrote them
+            msg += (
+                " (checkpoints written by the pre-r7 default host "
+                "engine resume with -engine host)"
+            )
+        sys.exit(f"tpu-tlc: {msg}")
+    rc = _report(r, constants, time.time() - t0, checkpoint=args.checkpoint)
     # cfg PROPERTIES are honored automatically after a clean safety pass
     # (TLC checks temporal properties from the same run); the sharded
     # drivers do not keep the state log the liveness engine needs
@@ -464,10 +521,23 @@ def main(argv=None):
         "-metrics", help="write per-level JSONL metrics to this file"
     )
     pc.add_argument(
-        "-checkpoint", help="checkpoint file (.npz); resume with -recover"
+        "-checkpoint",
+        help="checkpoint file (.npz): level-boundary frames are written "
+        "atomically every few levels; SIGTERM/SIGINT checkpoint at the "
+        "next boundary and exit resumably; resume with -recover",
     )
     pc.add_argument(
         "-recover", action="store_true", help="resume from -checkpoint"
+    )
+    pc.add_argument(
+        "-engine",
+        choices=["device", "host"],
+        default="device",
+        help="non-sharded engine: 'device' (fully device-resident BFS, "
+        "engine/device_bfs.py — the bench engine, with checkpoint/"
+        "recover, HBM-exhaustion recovery, and preemption-safe "
+        "shutdown; default) or 'host' (the host-driver engine/bfs.py, "
+        "kept for disk-backed state logs and hash dedup)",
     )
     pc.add_argument(
         "-cpu", action="store_true", help="force the CPU backend"

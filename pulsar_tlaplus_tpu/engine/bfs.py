@@ -58,8 +58,17 @@ class CheckerResult:
     truncated: bool = False  # stopped by time/state budget, not exhaustion
     # why a truncated run stopped: "max_states" | "time_budget" | "hbm"
     # | "row_window" (frontier-window rows exhausted at a completed
-    # level) | None for non-truncated runs or engines predating this
+    # level) | "preempted" (SIGTERM/SIGINT requested a resumable stop)
+    # | None for non-truncated runs or engines predating this
     stop_reason: Optional[str] = None
+    # how many times the run recovered from HBM exhaustion by
+    # rebuilding device state from the last checkpoint frame and
+    # continuing at degraded capacity (device engine)
+    hbm_recovered: int = 0
+    # gid of the violating/deadlocked state (engine-local numbering) —
+    # lets differential tests pin interrupted+resumed runs to the
+    # uninterrupted run's exact discovery order, not just its verdict
+    violation_gid: Optional[int] = None
     # expected fingerprint collisions at this state count (birthday
     # bound); 0.0 when dedup keys are exact.  TLC prints the analogous
     # "calculated (optimistic) probability" after every run.
@@ -241,8 +250,10 @@ class Checker:
         """Snapshot the full checker state (SURVEY.md §2.2-E8): sorted
         visited keys + frontier + trace log; resume continues BFS.  With a
         disk-backed state log only the (path, count) pair is recorded — the
-        log file itself is the durable storage."""
-        tmp = self.checkpoint_path + ".tmp.npz"
+        log file itself is the durable storage.  The atomic frame writer
+        is shared with the device engines (utils/ckpt.py)."""
+        from pulsar_tlaplus_tpu.utils import ckpt
+
         log = rs.log
         if isinstance(log, FileLog):
             log.sync()
@@ -256,32 +267,32 @@ class Checker:
                 parent=log.parents(),
                 action=log.actions(),
             )
-        np.savez_compressed(
-            tmp,
-            sig=np.frombuffer(self._config_sig().encode(), dtype=np.uint8),
-            **{
-                f"vk{i}": np.asarray(col) for i, col in enumerate(rs.vk)
-            },
-            n_visited=np.int64(rs.n_visited),
-            level_sizes=np.asarray(rs.level_sizes, np.int64),
-            frontier=rs.frontier,
-            frontier_gids=rs.frontier_gids,
-            wall_s=np.float64(time.time() - rs.t0),
-            **log_arrays,
+        ckpt.save_frame(
+            self.checkpoint_path,
+            self._config_sig(),
+            dict(
+                {
+                    f"vk{i}": np.asarray(col)
+                    for i, col in enumerate(rs.vk)
+                },
+                n_visited=np.int64(rs.n_visited),
+                level_sizes=np.asarray(rs.level_sizes, np.int64),
+                frontier=rs.frontier,
+                frontier_gids=rs.frontier_gids,
+                **log_arrays,
+            ),
+            wall_s=time.time() - rs.t0,
         )
-        import os
-
-        os.replace(tmp, self.checkpoint_path)
 
     def load_checkpoint(self):
         """Load a checkpoint dict (validates the config signature)."""
-        d = np.load(self.checkpoint_path)
-        sig = d["sig"].tobytes().decode()
-        if sig != self._config_sig():
-            raise ValueError(
-                "checkpoint was written by a different model configuration"
-            )
-        return d
+        from pulsar_tlaplus_tpu.utils import ckpt
+
+        return ckpt.load_frame(
+            self.checkpoint_path,
+            self._config_sig(),
+            what="model configuration",
+        )
 
     def run(self, resume: bool = False) -> CheckerResult:
         rs = _RunState()
@@ -436,6 +447,7 @@ class Checker:
             res.violation = "Deadlock"
             gid = deadlock_gid
         if gid is not None:
+            res.violation_gid = gid
             res.trace, res.trace_actions = build_trace(
                 self.model, self._unpack1, gid, rs.log
             )

@@ -57,13 +57,25 @@ import numpy as np
 from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
-from pulsar_tlaplus_tpu.utils import device
+from pulsar_tlaplus_tpu.utils import ckpt, device, faults
 from pulsar_tlaplus_tpu.utils.aot_cache import ajit
 from pulsar_tlaplus_tpu.ops import dedup, fpset
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
 BIG = jnp.int32(2**31 - 1)
+
+
+class _HbmExhausted(Exception):
+    """Internal control flow: a RESOURCE_EXHAUSTED surfaced while a
+    valid checkpoint frame exists — the run loop rebuilds device state
+    from that frame at degraded capacity instead of truncating."""
+
+    def __init__(self, nv: int, level_sizes, msg: str):
+        super().__init__(msg)
+        self.nv = nv
+        self.level_sizes = level_sizes
+        self.msg = msg
 # payload word: low 31 bits = accumulator slot index, bit 31 = the
 # candidate tag (visited entries carry payload 0, so the payload doubles
 # as the visited-vs-candidate sort tie-breaker)
@@ -102,6 +114,8 @@ class DeviceChecker:
         rows_window: str = "all",
         row_cap_states: Optional[int] = None,
         visited_impl: str = "fpset",
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 5,
     ):
         self.model = model
         self.layout = model.layout
@@ -260,6 +274,7 @@ class DeviceChecker:
         self.progress = progress
         self.metrics_path = metrics_path
         self.group = group
+        self._group0 = group  # pre-degradation group-ahead (see run())
         if seed_cap is not None:
             # sorted-column capacity of the host-seed merge path; a
             # bench-scale warm start (VERDICT r3: the first ~10 s of
@@ -267,6 +282,24 @@ class DeviceChecker:
             # tiny early levels pay full-width sort latency) needs a
             # bigger tier than the 2^16 default
             self.SEED_VCAP = self._round_cap(seed_cap)
+        # run-survivability state (round 7): level-boundary checkpoint
+        # frames shared with the sharded engine via utils/ckpt.py,
+        # HBM-exhaustion recovery, and preemption-safe shutdown
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self._hbm_recovered = 0
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        # True whenever the on-disk frame is valid AND no recovery has
+        # consumed it since: a second exhaustion without a fresh frame
+        # in between means recovery is not making progress — truncate
+        self._recover_armed = False
+        # set by a recovery: growth headroom drops to one accumulator
+        # (degraded capacity so the retry fits where the full-headroom
+        # run did not)
+        self._headroom_frozen = False
+        self._watcher = None
+        self._flush_seq = 0
         self._jits: Dict[tuple, object] = {}
         self.last_stats: Dict[str, float] = {}
         # PTT_STAGE_TIMING=1: drain after every dispatch and charge the
@@ -1232,7 +1265,14 @@ class DeviceChecker:
         drain(app)
         mark("append")
         del app, ak, arows, flag_w
-        drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
+        if fpmode:
+            drain(
+                self._stats_jit()(
+                    jnp.int32(0), BIG, viol0, jnp.zeros((3,), jnp.int32)
+                )
+            )
+        else:
+            drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
         drain(
             self._chain_jit(4)(
                 z((self.PCAP,), jnp.int32),
@@ -1279,11 +1319,55 @@ class DeviceChecker:
             mark("seed")
         return time.time() - t0
 
-    def run(self, seed=None) -> CheckerResult:
+    def run(self, seed=None, resume: bool = False) -> CheckerResult:
         """``seed``: optional host-enumerated BFS prefix
         ``(packed_rows, parent_gids, action_lanes, level_sizes)`` —
-        see :meth:`_load_seed`."""
+        see :meth:`_load_seed`.  ``resume=True`` rebuilds the full
+        device state from the ``checkpoint_path`` frame and continues
+        the interrupted run (wall clock cumulative across resumes; the
+        time budget gets a fresh clock)."""
         t0 = time.time()
+        self._budget_t0 = t0
+        self._host_wait_s = 0.0
+        self._bufs_poisoned = False
+        self._last_fpm = None
+        self._flush_seq = 0
+        # per-run recovery/telemetry state: a fresh run() must not
+        # inherit a previous run's degraded capacity or frame counts
+        self._hbm_recovered = 0
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._recover_armed = False
+        self._headroom_frozen = False
+        self.group = self._group0
+        # preemption-safe shutdown (TPU-VM contract): SIGTERM/SIGINT
+        # request a checkpoint at the next level boundary; only armed
+        # when there is a frame path to write to
+        watcher = ckpt.PreemptionWatcher(
+            enabled=bool(self.checkpoint_path), log=self._log
+        )
+        self._watcher = watcher
+        try:
+            with watcher:
+                return self._run(t0, seed, resume)
+        finally:
+            self._watcher = None
+
+    def _run(self, t0, seed, resume) -> CheckerResult:
+        if resume:
+            if seed is not None:
+                raise ValueError("resume and seed are mutually exclusive")
+            if not self.checkpoint_path:
+                raise ValueError("resume requires checkpoint_path")
+            (
+                bufs, st, rb, level_sizes, level_base, nf, saved_wall,
+            ) = self._restore_frame()
+            t0 = time.time() - saved_wall
+            self._recover_armed = True  # the on-disk frame is valid
+            stats = self._fetch(st)
+            return self._run_recoverable(
+                t0, bufs, st, rb, level_sizes, level_base, nf, stats
+            )
         m = self.model
         n_inv = len(self.invariant_names)
         K = self.K
@@ -1316,87 +1400,14 @@ class DeviceChecker:
             # failures] — ride the regular stats fetch
             st["fpm"] = jnp.zeros((3,), jnp.int32)
 
-        self._host_wait_s = 0.0
-        self._bufs_poisoned = False
-        self._last_fpm = None
-
-        def fetch():
-            tf = time.time()
-            stats_fn = self._stats_jit()
-            if fpmode:
-                out = np.asarray(
-                    stats_fn(
-                        st["n_visited"], st["dead_gid"], st["viol"],
-                        st["fpm"],
-                    )
-                )
-            else:
-                out = np.asarray(
-                    stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
-                )
-            self._host_wait_s += time.time() - tf
-            if fpmode:
-                self._last_fpm = out[2 + n_inv:]
-                if self._last_fpm[2]:
-                    # probe overflow: lanes were dropped by flushes
-                    # already appended — the counts cannot be trusted,
-                    # so this is a hard abort, not a truncation
-                    raise RuntimeError(
-                        "fpset probe overflow "
-                        f"({int(self._last_fpm[2])} lanes) — raise "
-                        "visited_cap (the table broke its load-factor "
-                        "contract)"
-                    )
-            return out
-
         # frontier-window state: gid of rows[0], and whether row writes
         # are still landing in the window (False = diverted to scratch;
         # the level being built can no longer become a frontier)
         rb = {"row_base": 0, "rows_ok": True}
 
-        def flush(n_acc: int, acc_base: int, is_init: bool):
-            """Dispatch the dedup + append for the current accumulator
-            fill (``n_acc`` valid lanes covering source rows starting
-            at ``acc_base``): table probe-or-insert in fpset mode, the
-            legacy 3-sort merge in sort mode — identical flag/append
-            contract either way."""
-            if fpmode:
-                out = self._stage_mark(
-                    "flush",
-                    self._fpflush_jit()(
-                        *bufs["vk"], *bufs["ak"], jnp.int32(n_acc),
-                        st["fpm"],
-                    ),
-                )
-                bufs["vk"] = out[:K]
-                n_new, flag_acc, st["fpm"] = (
-                    out[K], out[K + 1], out[K + 2]
-                )
-            else:
-                out = self._stage_mark(
-                    "flush",
-                    self._flush_jit()(
-                        *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
-                    ),
-                )
-                bufs["vk"] = out[:K]
-                n_new, flag_acc = out[K], out[K + 1]
-            (
-                bufs["rows"], bufs["parent"], bufs["lane"],
-                st["n_visited"], st["viol"],
-            ) = self._stage_mark(
-                "append",
-                self._append_jit()(
-                    bufs["rows"], bufs["parent"], bufs["lane"],
-                    bufs["arows"], flag_acc, n_new, st["n_visited"],
-                    st["viol"], jnp.int32(acc_base), jnp.bool_(is_init),
-                    jnp.int32(rb["row_base"]), jnp.bool_(rb["rows_ok"]),
-                ),
-            )
-
         if seed is not None:
             level_sizes = self._load_seed(bufs, st, seed)
-            stats = fetch()
+            stats = self._fetch(st)
             # early anchor record: the sustained-60s window needs a
             # reference point before the deep levels begin
             self._emit_metrics(
@@ -1439,29 +1450,203 @@ class DeviceChecker:
                 bufs["ak"], bufs["arows"] = out[:K], out[K]
                 w += 1
                 if w == self.FLUSH or f_off + self.NCs >= n_init:
-                    flush(w * self.NCs, group_base, True)
+                    self._flush_acc(
+                        bufs, st, rb, w * self.NCs, group_base, True
+                    )
                     group_base = f_off + self.NCs
                     w = 0
-            stats = fetch()
+            stats = self._fetch(st)
             level_sizes = [int(stats[0])]
 
-        # ---- BFS levels ----
-        # invariant the dispatch loop maintains: every buffer can absorb
-        # the worst case of all in-flight (unfetched) flushes, i.e.
-        # nv_bound = nv + pending * ACAP stays within VCAP and LCAP.
-        # The current frontier is the contiguous row-store range
-        # [level_base, level_base + nf).
         nv = int(stats[0])
         level_base = nv - (level_sizes[-1] if level_sizes else 0)
         nf = nv - level_base
+        return self._run_recoverable(
+            t0, bufs, st, rb, level_sizes, level_base, nf, stats
+        )
+
+    def _fetch(self, st):
+        """One stats fetch (the only hot-path host sync): returns the
+        numpy stats vector and fail-stops on fpset probe overflow."""
+        tf = time.time()
+        stats_fn = self._stats_jit()
+        fpmode = self.visited_impl == "fpset"
+        if fpmode:
+            out = np.asarray(
+                stats_fn(
+                    st["n_visited"], st["dead_gid"], st["viol"],
+                    st["fpm"],
+                )
+            )
+        else:
+            out = np.asarray(
+                stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
+            )
+        self._host_wait_s += time.time() - tf
+        if fpmode:
+            n_inv = len(self.invariant_names)
+            self._last_fpm = out[2 + n_inv:]
+            if self._last_fpm[2]:
+                # probe overflow: lanes were dropped by flushes
+                # already appended — the counts cannot be trusted,
+                # so this is a hard abort, not a truncation
+                raise RuntimeError(
+                    "fpset probe overflow "
+                    f"({int(self._last_fpm[2])} lanes) — raise "
+                    "visited_cap (the table broke its load-factor "
+                    "contract)"
+                )
+        return out
+
+    def _flush_acc(self, bufs, st, rb, n_acc, acc_base, is_init):
+        """Dispatch the dedup + append for the current accumulator
+        fill (``n_acc`` valid lanes covering source rows starting
+        at ``acc_base``): table probe-or-insert in fpset mode, the
+        legacy 3-sort merge in sort mode — identical flag/append
+        contract either way."""
+        K = self.K
+        fpmode = self.visited_impl == "fpset"
+        self._flush_seq += 1
+        kinds = faults.poll("flush", self._flush_seq)
+        if "oom" in kinds:
+            raise faults.oom_error("flush", self._flush_seq)
+        if "fpset_fail" in kinds and fpmode:
+            # synthetic stage overflow: account one dropped lane in
+            # the device metrics — the next stats fetch fail-stops
+            # exactly like a real probe overflow would
+            st["fpm"] = st["fpm"] + jnp.asarray([0, 0, 1], jnp.int32)
+        if fpmode:
+            out = self._stage_mark(
+                "flush",
+                self._fpflush_jit()(
+                    *bufs["vk"], *bufs["ak"], jnp.int32(n_acc),
+                    st["fpm"],
+                ),
+            )
+            bufs["vk"] = out[:K]
+            n_new, flag_acc, st["fpm"] = (
+                out[K], out[K + 1], out[K + 2]
+            )
+        else:
+            out = self._stage_mark(
+                "flush",
+                self._flush_jit()(
+                    *bufs["vk"], *bufs["ak"], jnp.int32(n_acc)
+                ),
+            )
+            bufs["vk"] = out[:K]
+            n_new, flag_acc = out[K], out[K + 1]
+        (
+            bufs["rows"], bufs["parent"], bufs["lane"],
+            st["n_visited"], st["viol"],
+        ) = self._stage_mark(
+            "append",
+            self._append_jit()(
+                bufs["rows"], bufs["parent"], bufs["lane"],
+                bufs["arows"], flag_acc, n_new, st["n_visited"],
+                st["viol"], jnp.int32(acc_base), jnp.bool_(is_init),
+                jnp.int32(rb["row_base"]), jnp.bool_(rb["rows_ok"]),
+            ),
+        )
+
+    def _run_recoverable(
+        self, t0, bufs, st, rb, level_sizes, level_base, nf, stats
+    ) -> CheckerResult:
+        """The level loop under the HBM-exhaustion recovery contract:
+        a RESOURCE_EXHAUSTED with a valid checkpoint frame on disk
+        frees the (possibly poisoned) device buffers, rebuilds state
+        from the frame, and continues at degraded capacity — halved
+        dispatch group-ahead and frozen growth headroom.  Only when
+        recovery itself exhausts memory (or no fresh frame was written
+        since the last recovery) does the run truncate with
+        ``stop_reason="hbm"``."""
+        while True:
+            try:
+                return self._level_loop(
+                    t0, bufs, st, rb, level_sizes, level_base, nf,
+                    stats,
+                )
+            except _HbmExhausted as hx:
+                last = (hx.nv, hx.level_sizes, hx.msg)
+                # the rebuild happens OUTSIDE this except block: the
+                # exception's traceback pins _level_loop's frame
+                # locals (accumulator tuples, expand windows) and the
+                # chained original XLA error — restoring under it
+                # would re-OOM exactly when memory is tightest
+            self._hbm_recovered += 1
+            self._recover_armed = False
+            # degraded capacity for the retry: halve the dispatch
+            # group-ahead (fewer in-flight flushes = smaller
+            # worst-case transients) and freeze growth headroom
+            self.group = max(1, self.group // 2)
+            self._headroom_frozen = True
+            self._log(
+                "HBM exhausted: recovering from the last "
+                f"checkpoint frame (recovery #{self._hbm_recovered}"
+                f", group={self.group}) — {last[2][:120]}"
+            )
+            # drop every device buffer reference BEFORE the restore
+            # allocates: the poisoned/donated storage must be freed
+            # first or the rebuild would OOM on top of it
+            bufs.clear()
+            st.clear()
+            try:
+                (
+                    bufs, st, rb, level_sizes, level_base, nf, _w,
+                ) = self._restore_frame()
+                stats = self._fetch(st)
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                # recovery itself exhausted memory: report what
+                # the interrupted run had verified, honestly
+                self._bufs_poisoned = True
+                return self._result(
+                    t0, last[0], last[1], {},
+                    truncated=True, stop_reason="hbm",
+                )
+
+    def _level_loop(
+        self, t0, bufs, st, rb, level_sizes, level_base, nf, stats
+    ) -> CheckerResult:
+        """BFS levels over an initialized-or-restored level frame.
+
+        Loop invariant: every buffer can absorb the worst case of all
+        in-flight (unfetched) flushes, i.e. nv_bound = nv + pending *
+        ACAP stays within VCAP and LCAP.  The current frontier is the
+        contiguous row-store range [level_base, level_base + nf)."""
+        K = self.K
+        nv = int(stats[0])
         while True:
             reason = self._stop_reason(stats, t0)
             if reason is not None and not (
                 reason.get("truncated") and nf == 0
             ):
+                if reason.get("truncated"):
+                    # budget stops leave a resumable frame (-recover
+                    # continues the search where TLC would)
+                    self._save_frame(
+                        bufs, st, rb, level_sizes, level_base, nf, nv,
+                        t0,
+                    )
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             if nf == 0:
                 return self._result(t0, nv, level_sizes, bufs)
+            if self._watcher is not None and self._watcher.requested:
+                # preemption-safe shutdown: SIGTERM/SIGINT landed since
+                # the last boundary — write a resumable frame and exit.
+                # If the save is refused because the rows window was
+                # lost, fall through so the honest row_window stop
+                # below reports instead (an older frame may still
+                # exist on disk; "preempted" must not mask that state)
+                saved = self._save_frame(
+                    bufs, st, rb, level_sizes, level_base, nf, nv, t0
+                )
+                if saved or rb["rows_ok"]:
+                    return self._result(
+                        t0, nv, level_sizes, bufs, truncated=True,
+                        stop_reason="preempted",
+                    )
             if self._stage_timing:
                 self._log(
                     f"level start: nf={nf} windows={-(-nf // self.G)}"
@@ -1509,6 +1694,15 @@ class DeviceChecker:
             w = 0  # accumulator windows filled since the last flush
             group_f0 = 0  # level offset of the first window in the acc
             try:
+                # deterministic fault sites (utils/faults.py): kill/
+                # sigterm fire inside poll; an injected oom raises the
+                # same RESOURCE_EXHAUSTED path a real allocator failure
+                # takes (which is the point of the drill)
+                kinds = faults.poll("level", len(level_sizes) + 1)
+                if "oom" in kinds:
+                    raise faults.oom_error(
+                        "level", len(level_sizes) + 1
+                    )
                 for f_off in range(0, nf, self.G):
                     last = f_off + self.G >= nf
                     out = self._stage_mark(
@@ -1549,7 +1743,7 @@ class DeviceChecker:
                         or pending >= self.group
                     )
                     if need_sync:
-                        stats = fetch()
+                        stats = self._fetch(st)
                         nv, pending = int(stats[0]), 0
                         # intra-level progress record: deep levels run
                         # for minutes, and the sustained-window metrics
@@ -1564,8 +1758,15 @@ class DeviceChecker:
                             break
                         # grow with enough headroom for a full group of
                         # in-flight flushes, or every flush would sync
-                        # (growth doubles, so this stays rare)
-                        head = (self.group + 1) * self.ACAP
+                        # (growth doubles, so this stays rare).  After
+                        # an HBM recovery the headroom is frozen at one
+                        # accumulator — degraded capacity so the retry
+                        # fits where the full-headroom run did not
+                        head = (
+                            self.ACAP
+                            if self._headroom_frozen
+                            else (self.group + 1) * self.ACAP
+                        )
                         if nv + self.ACAP > self.VCAP:
                             self._grow_visited(bufs, nv + head)
                         if nv + self.APAD > self.PCAP:
@@ -1587,25 +1788,32 @@ class DeviceChecker:
                                 "rows window full: dropping rows for "
                                 "the rest of this level"
                             )
-                    flush(w * self.NCs, level_base + group_f0, False)
+                    self._flush_acc(
+                        bufs, st, rb, w * self.NCs,
+                        level_base + group_f0, False,
+                    )
                     pending += 1
                     group_f0 = f_off + self.G
                     w = 0
             except Exception as e:  # noqa: BLE001
                 if "RESOURCE_EXHAUSTED" not in str(e):
                     raise
-                # HBM exhausted: report what was checked so far
-                # (truncated).  Only the small stats scalars are read
-                # from here on; the big buffers may hold donated/
-                # poisoned storage.
+                if self._can_recover():
+                    raise _HbmExhausted(nv, list(level_sizes), repr(e))
+                # HBM exhausted with no frame to rebuild from: report
+                # what was checked so far (truncated).  Only the small
+                # stats scalars are read from here on; the big buffers
+                # may hold donated/poisoned storage.
                 self._log(f"HBM exhausted mid-level: truncating ({e!r:.120})")
                 self._bufs_poisoned = True
                 stop = True
             try:
-                stats = fetch()
+                stats = self._fetch(st)
             except Exception as e:  # noqa: BLE001
                 if "RESOURCE_EXHAUSTED" not in str(e):
                     raise
+                if self._can_recover():
+                    raise _HbmExhausted(nv, list(level_sizes), repr(e))
                 self._bufs_poisoned = True
                 stop = True  # keep the last successfully fetched stats
             nv = int(stats[0])
@@ -1622,17 +1830,272 @@ class DeviceChecker:
                 reason = self._stop_reason(stats, t0) or {
                     "truncated": True, "stop_reason": "hbm"
                 }
+                if reason.get("truncated") and not self._bufs_poisoned:
+                    # mid-level stop: snapshot rewinds to the level
+                    # boundary (the partial last entry re-derives on
+                    # resume — every already-appended state dedups to
+                    # a no-op, so the retried level is exact)
+                    self._save_frame(
+                        bufs, st, rb, level_sizes[:-1], level_base, nf,
+                        nv, t0,
+                    )
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             level_base += nf
             nf = level_count
             # (frontier mode: the rows_ok check and the frontier shift
             # happen at the TOP of the next iteration, so the seeded
             # first level takes the same path as every later level)
+            if (
+                self.checkpoint_path
+                and nf
+                and len(level_sizes) % self.checkpoint_every == 0
+            ):
+                self._save_frame(
+                    bufs, st, rb, level_sizes, level_base, nf, nv, t0
+                )
+
+    # ------------------------------------------------ checkpoint/resume
+
+    def _model_sig(self) -> str:
+        """Model identity for the checkpoint signature (same contract
+        as the sharded engine's): hand models carry their Constants in
+        ``.c``; compiled specs are identified by module name + constant
+        bindings + lane structure."""
+        c = getattr(self.model, "c", None)
+        if c is not None:
+            return repr(c)
+        spec = getattr(self.model, "spec", None)
+        if spec is not None:
+            return repr(
+                (
+                    getattr(spec.module, "name", "?"),
+                    sorted(
+                        (k, repr(v)) for k, v in spec.constants.items()
+                    ),
+                    tuple(getattr(self.model, "lane_labels", ())),
+                )
+            )
+        return type(self.model).__name__
+
+    def _config_sig(self) -> str:
+        """Everything a frame must agree on to be resumable here: the
+        model hash, invariant set, key geometry (fp_bits regime), the
+        visited/rows implementations, and the engine frame revision.
+        Capacity tiers and fpset geometry live in the frame ARRAYS
+        (tcap, n_visited, rows_lo) — a resumed run may legally raise
+        ``max_states`` or ``row_cap_states``."""
+        return ckpt.config_sig(
+            model=self._model_sig(),
+            invariants=self.invariant_names,
+            check_deadlock=self.check_deadlock,
+            state_bits=self.layout.total_bits,
+            key_cols=self.K,
+            key_exact=self.keys.exact,
+            visited_impl=self.visited_impl,
+            rows_window=self.rows_window,
+            engine="device_bfs_r7",
+        )
+
+    def _can_recover(self) -> bool:
+        return (
+            self._recover_armed
+            and self.checkpoint_path is not None
+            and os.path.exists(self.checkpoint_path)
+        )
+
+    def _save_frame(
+        self, bufs, st, rb, level_sizes, level_base, nf, nv, t0
+    ) -> bool:
+        """Write one resumable frame (atomic tmp + os.replace via
+        utils/ckpt.py); returns True if a frame was written.
+
+        Frame meaning: "``nv`` states discovered, about to (re-)expand
+        the contiguous frontier [level_base, level_base + nf)".  A
+        mid-level frame (``nv > level_base + nf``) is exact because the
+        partially appended next level re-derives by dedup idempotence.
+        Saved rows span [rows_lo, nv): the full store in
+        ``rows_window="all"`` (liveness keeps reading it after resume),
+        the live window from the frontier start in frontier mode."""
+        if not self.checkpoint_path:
+            return False
+        if self._bufs_poisoned or not rb["rows_ok"]:
+            # device rows unusable — keep the previous (older but
+            # valid) frame rather than overwrite it with garbage
+            return False
+        W = self.W
+        lo = 0 if self.rows_window == "all" else level_base
+        arrays = {
+            "n_visited": np.int64(nv),
+            "level_sizes": np.asarray(level_sizes, np.int64),
+            "lb": np.int64(level_base),
+            "nf": np.int64(nf),
+            "rows_lo": np.int64(lo),
+            "hbm_recovered": np.int64(self._hbm_recovered),
+            "fpm": (
+                np.asarray(st["fpm"])
+                if self.visited_impl == "fpset"
+                else np.zeros((3,), np.int32)
+            ),
+            "parent": np.asarray(bufs["parent"][:nv]),
+            "lane": np.asarray(bufs["lane"][:nv]),
+            "rows": np.asarray(
+                bufs["rows"][
+                    (lo - rb["row_base"]) * W:
+                    (nv - rb["row_base"]) * W
+                ]
+            ),
+        }
+        if self.visited_impl == "fpset":
+            # compacted occupancy (keys + slot index): frame size
+            # scales with the state count, not the table tier
+            arrays.update(
+                ckpt.pack_fpset(
+                    tuple(np.asarray(c) for c in bufs["vk"])
+                )
+            )
+        else:
+            for i, col in enumerate(bufs["vk"]):
+                # sorted columns: the first nv entries are the real
+                # keys (SENTINEL pad sorts behind every real key)
+                arrays[f"vk{i}"] = np.asarray(col[:nv])
+        nbytes = ckpt.save_frame(
+            self.checkpoint_path, self._config_sig(), arrays,
+            wall_s=time.time() - t0,
+        )
+        self._ckpt_frames += 1
+        self._ckpt_bytes += nbytes
+        self._recover_armed = True
+        self.last_stats.update(
+            ckpt_frames=self._ckpt_frames, ckpt_bytes=self._ckpt_bytes
+        )
+        self._log(
+            f"checkpoint: level {len(level_sizes)}, {nv} states "
+            f"({nbytes >> 10} KiB) -> {self.checkpoint_path}"
+        )
+        return True
+
+    def _restore_frame(self):
+        """Rebuild device buffers + level frame from the checkpoint;
+        returns (bufs, st, rb, level_sizes, level_base, nf, wall_s)."""
+        d = ckpt.load_frame(self.checkpoint_path, self._config_sig())
+        K, W = self.K, self.W
+        nv = int(d["n_visited"])
+        level_sizes = [int(x) for x in d["level_sizes"]]
+        level_base = int(d["lb"])
+        nf = int(d["nf"])
+        lo = int(d["rows_lo"])
+        if nv > self.SCAP:
+            raise ValueError(
+                f"checkpoint holds {nv} states — beyond max_states "
+                f"({self.SCAP}); raise max_states to resume it"
+            )
+        if self.visited_impl == "fpset":
+            cols = ckpt.unpack_fpset(d, K)
+            # the snapshot fixes the table tier (jit programs are
+            # tier-keyed, so no cache invalidation is needed); growth,
+            # if the resumed run needs it, goes through regular rehash.
+            # jnp.array (copy=True), NOT jnp.asarray: on the CPU
+            # backend asarray can alias the numpy buffer zero-copy,
+            # and the flush DONATES these columns — donating memory
+            # numpy still owns is a use-after-free (observed as flaky
+            # probe overflows and GC segfaults in the resume tests)
+            self.TCAP = cols[0].shape[0] - 1
+            self.VCAP = self.TCAP // 2
+            vk = tuple(jnp.array(c) for c in cols)
+        else:
+            while self.VCAP < nv + self.ACAP:
+                self.VCAP *= 2
+            vk = tuple(
+                jnp.concatenate(
+                    [
+                        jnp.asarray(np.asarray(d[f"vk{i}"], np.uint32)),
+                        jnp.full(
+                            (self.VCAP - nv,), SENTINEL, jnp.uint32
+                        ),
+                    ]
+                )
+                for i in range(K)
+            )
+        # size the row/log tiers BEFORE allocating (same doubling-with-
+        # cap formulas as _grow_store/_grow_logs, minus the buffers)
+        need = nv + self.APAD
+        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        if self.rows_window == "all":
+            while self.LCAP < need:
+                self.LCAP += min(
+                    self.LCAP, max(cap - self.LCAP, need - self.LCAP)
+                )
+        elif nv - lo + self.APAD > self.LCAP:
+            raise ValueError(
+                f"checkpoint frontier ({nv - lo} rows) exceeds the "
+                f"frontier rows window ({self.LCAP}); raise "
+                "row_cap_states"
+            )
+        while self.PCAP < need:
+            self.PCAP += min(
+                self.PCAP, max(cap - self.PCAP, need - self.PCAP)
+            )
+        rdata = np.asarray(d["rows"], np.uint32)
+        bufs = {
+            "vk": vk,
+            "ak": tuple(
+                jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
+                for _ in range(K)
+            ),
+            "arows": jnp.zeros((self.W, self.ACAP), jnp.uint32),
+            # saved rows land at their absolute offset in "all" mode
+            # (lo == 0) and at window offset 0 with row_base = lo in
+            # frontier mode — both are "offset (lo - row_base) = 0"
+            "rows": jnp.concatenate(
+                [
+                    jnp.asarray(rdata),
+                    jnp.zeros(
+                        (self._rows_len() - len(rdata),), jnp.uint32
+                    ),
+                ]
+            ),
+            "parent": jnp.concatenate(
+                [
+                    jnp.asarray(np.asarray(d["parent"], np.int32)),
+                    jnp.zeros((self.PCAP - nv,), jnp.int32),
+                ]
+            ),
+            "lane": jnp.concatenate(
+                [
+                    jnp.asarray(np.asarray(d["lane"], np.int32)),
+                    jnp.zeros((self.PCAP - nv,), jnp.int32),
+                ]
+            ),
+        }
+        n_inv = len(self.invariant_names)
+        st = {
+            "n_visited": jnp.int32(nv),
+            "dead_gid": BIG,
+            "viol": jnp.full((n_inv,), int(BIG), jnp.int32),
+        }
+        if self.visited_impl == "fpset":
+            st["fpm"] = jnp.asarray(np.asarray(d["fpm"], np.int32))
+        if "hbm_recovered" in d:
+            self._hbm_recovered = max(
+                self._hbm_recovered, int(d["hbm_recovered"])
+            )
+        rb = {"row_base": lo, "rows_ok": True}
+        self._log(
+            f"resumed at level {len(level_sizes)}: {nv} states, "
+            f"frontier {nf}"
+        )
+        return bufs, st, rb, level_sizes, level_base, nf, float(
+            d["wall_s"]
+        )
 
     def _over_time(self, t0) -> bool:
+        # the budget runs on its own clock: ``t0`` is rewound on resume
+        # so wall_s stays cumulative, but a resumed run always gets
+        # ``time_budget_s`` of fresh runway
         return (
             self.time_budget_s is not None
-            and time.time() - t0 > self.time_budget_s
+            and time.time() - getattr(self, "_budget_t0", t0)
+            > self.time_budget_s
         )
 
     def _stop_reason(self, stats, t0) -> Optional[dict]:
@@ -1743,6 +2206,12 @@ class DeviceChecker:
                 fpset_table_cap=self.TCAP,
                 fpset_occupancy=round(nv / max(self.TCAP, 1), 4),
             )
+        # survivability telemetry for bench artifacts (r7)
+        self.last_stats.update(
+            hbm_recovered=self._hbm_recovered,
+            ckpt_frames=self._ckpt_frames,
+            ckpt_bytes=self._ckpt_bytes,
+        )
         res = CheckerResult(
             distinct_states=nv,
             diameter=len(level_sizes),
@@ -1752,6 +2221,7 @@ class DeviceChecker:
             level_sizes=level_sizes,
             truncated=truncated,
             stop_reason=stop_reason if truncated else None,
+            hbm_recovered=self._hbm_recovered,
             fp_collision_prob=self.keys.collision_prob(nv),
         )
         gid = None
@@ -1762,6 +2232,7 @@ class DeviceChecker:
             res.violation = "Deadlock"
             gid = dead_gid
         if gid is not None:
+            res.violation_gid = gid
             if getattr(self, "_bufs_poisoned", False):
                 # after RESOURCE_EXHAUSTED the parent/lane logs may hold
                 # donated/poisoned storage — walking them could crash or

@@ -378,41 +378,47 @@ class ShardedChecker:
         self, vk, n_visited, log, level_sizes, frontier, fgids, t0
     ):
         """Level-boundary snapshot (SURVEY.md §2.2-E8, sharded): per-shard
-        visited columns + per-shard frontier + trace log."""
-        import os
+        visited columns + per-shard frontier + trace log.  The atomic
+        frame writer is shared with the device engines (utils/ckpt.py)."""
+        from pulsar_tlaplus_tpu.utils import ckpt
 
-        tmp = self.checkpoint_path + ".tmp.npz"
         total = sum(len(f) for f in frontier)
-        np.savez_compressed(
-            tmp,
-            sig=np.frombuffer(self._config_sig().encode(), dtype=np.uint8),
-            **{f"vk{i}": np.asarray(col) for i, col in enumerate(vk)},
-            n_visited=n_visited,
-            level_sizes=np.asarray(level_sizes, np.int64),
-            fr=(
-                np.concatenate(frontier)
-                if total
-                else np.zeros((0, self.layout.W), np.uint32)
+        ckpt.save_frame(
+            self.checkpoint_path,
+            self._config_sig(),
+            dict(
+                {
+                    f"vk{i}": np.asarray(col)
+                    for i, col in enumerate(vk)
+                },
+                n_visited=n_visited,
+                level_sizes=np.asarray(level_sizes, np.int64),
+                fr=(
+                    np.concatenate(frontier)
+                    if total
+                    else np.zeros((0, self.layout.W), np.uint32)
+                ),
+                fr_lens=np.asarray(
+                    [len(f) for f in frontier], np.int64
+                ),
+                fgids=(
+                    np.concatenate(fgids)
+                    if total
+                    else np.zeros((0,), np.int64)
+                ),
+                packed=log.packed_matrix(),
+                parent=log.parents(),
+                action=log.actions(),
             ),
-            fr_lens=np.asarray([len(f) for f in frontier], np.int64),
-            fgids=(
-                np.concatenate(fgids) if total else np.zeros((0,), np.int64)
-            ),
-            packed=log.packed_matrix(),
-            parent=log.parents(),
-            action=log.actions(),
-            wall_s=np.float64(time.time() - t0),
+            wall_s=time.time() - t0,
         )
-        os.replace(tmp, self.checkpoint_path)
 
     def load_checkpoint(self):
-        d = np.load(self.checkpoint_path)
-        sig = d["sig"].tobytes().decode()
-        if sig != self._config_sig():
-            raise ValueError(
-                "checkpoint was written by a different configuration"
-            )
-        return d
+        from pulsar_tlaplus_tpu.utils import ckpt
+
+        return ckpt.load_frame(
+            self.checkpoint_path, self._config_sig()
+        )
 
     def run(self, resume: bool = False) -> CheckerResult:
         m = self.model

@@ -397,6 +397,15 @@ class FPSet:
             self.cols, kcols, valid
         )
         nf = int(n_failed)
+        from pulsar_tlaplus_tpu.utils import faults
+
+        if "fpset_fail" in faults.poll(
+            "flush", self.stats["inserts"] + 1
+        ):
+            # injected stage overflow (PTT_FAULT=fpset_fail@flush:N):
+            # exercises the fail-stop contract below without needing a
+            # genuinely overloaded table
+            nf += 1
         self.n += int(jnp.sum(is_new.astype(jnp.int32)))
         self.stats["inserts"] += 1
         self.stats["probe_rounds"] += int(rounds)

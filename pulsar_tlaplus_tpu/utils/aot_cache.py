@@ -219,8 +219,23 @@ class _AJit:
                 self.events[sig] = "hit"
                 self._paths[sig] = path
                 return comp
-            except Exception:  # noqa: BLE001
-                pass  # stale/incompatible entry: recompile below
+            except Exception as e:  # noqa: BLE001
+                # digest-mismatch / truncated / unpicklable /
+                # incompatible entry: a cache miss, never a crash — a
+                # corrupt cache must not kill a run.  Delete the bad
+                # entry so no later process trips over it either.
+                import sys
+
+                print(
+                    f"note: AOT cache entry {os.path.basename(path)!r} "
+                    f"is unusable ({type(e).__name__}: {e}); deleting "
+                    "and recompiling",
+                    file=sys.stderr,
+                )
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         comp = lowered.compile()
         self.events[sig] = "compile"
         comp._ptt_verified = True  # freshly compiled, nothing to verify

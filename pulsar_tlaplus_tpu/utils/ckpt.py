@@ -1,0 +1,249 @@
+"""Shared checkpoint-frame layer — the run-survivability substrate.
+
+TLC's killer production feature is that a week-long run survives
+crashes via its ``states/`` checkpoint directory.  This module is the
+engine-agnostic half of that story for the JAX engines: an atomic
+``tmp + os.replace`` npz frame with a config signature, a format
+version, a compacted-occupancy codec for hash-table (fpset) visited
+sets, and a preemption watcher that turns SIGTERM/SIGINT into a
+"checkpoint at the next level boundary" request (the TPU-VM
+preemption contract).
+
+Design rules every engine follows:
+
+- **Atomicity**: a frame is written to ``<path>.tmp.npz`` and
+  ``os.replace``d over the target, so a crash mid-write can never
+  leave a half-frame where a resumable one used to be.
+- **Signature**: every frame embeds a config signature (model hash,
+  invariant set, key geometry, visited impl, engine format revision).
+  ``load_frame`` refuses a frame written under a different
+  configuration with a clean error — two specs can never silently
+  resume each other's state.
+- **Format version**: frames carry ``__format__``; readers accept
+  every version up to :data:`FORMAT_VERSION` (v1 frames predate the
+  field and the compacted fpset codec; they still load).
+- **Compacted fpset occupancy** (:func:`pack_fpset` /
+  :func:`unpack_fpset`): hash-table occupancy is scattered across the
+  table, so full-column snapshots carry mostly SENTINEL runs.  The
+  compacted codec stores only the occupied slots (keys + slot index)
+  — frame size scales with the *state count*, not the table tier.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# v1: full-column fpset snapshots, no version field (round-4/6 sharded
+# frames).  v2: ``__format__`` field + compacted-occupancy fpset codec
+# + the device_bfs frame layout.  Readers accept <= FORMAT_VERSION.
+FORMAT_VERSION = 2
+
+_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def config_sig(**fields) -> str:
+    """Canonical signature string from keyword fields (sorted, so two
+    call sites building the same logical config always agree)."""
+    return repr(tuple(sorted((k, repr(v)) for k, v in fields.items())))
+
+
+def save_frame(
+    path: str, sig: str, arrays: Dict[str, np.ndarray],
+    wall_s: float = 0.0,
+) -> int:
+    """Write one checkpoint frame atomically; returns its size in
+    bytes.  ``sig`` is the writer's config signature (verified by
+    :func:`load_frame`); ``wall_s`` the cumulative run wall time so a
+    resumed run's states/sec stays meaningful end to end."""
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(
+        tmp,
+        __format__=np.int64(FORMAT_VERSION),
+        sig=np.frombuffer(sig.encode(), dtype=np.uint8),
+        wall_s=np.float64(wall_s),
+        **arrays,
+    )
+    nbytes = os.path.getsize(tmp)
+    os.replace(tmp, path)  # atomic vs crashes and concurrent readers
+    return nbytes
+
+
+def load_frame(path: str, sig: str, what: str = "configuration"):
+    """Open a frame, verify format + signature, return the npz dict.
+
+    A file that isn't a frame (arbitrary npz, truncated write,
+    pre-frame formats) fails with one clean "unrecognized checkpoint
+    format" error rather than a raw KeyError/zipfile error; a missing
+    file raises FileNotFoundError untouched (callers distinguish
+    "nothing to resume" from "corrupt").
+    """
+    try:
+        d = np.load(path)
+        frame_sig = d["sig"].tobytes().decode()
+        version = int(d["__format__"]) if "__format__" in d else 1
+    except FileNotFoundError:
+        raise  # a missing file is not a format problem
+    except Exception as e:  # noqa: BLE001
+        raise ValueError(
+            f"unrecognized checkpoint format at {path!r} — not written "
+            f"by this engine ({type(e).__name__}: {e})"
+        ) from e
+    if version > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint frame format v{version} is newer than this "
+            f"build supports (v{FORMAT_VERSION}); upgrade to resume it"
+        )
+    if frame_sig != sig:
+        raise ValueError(f"checkpoint was written by a different {what}")
+    return d
+
+
+# ------------------------------------------------- fpset frame codec
+
+
+def pack_fpset(
+    cols: Sequence[np.ndarray], prefix: str = "fp"
+) -> Dict[str, np.ndarray]:
+    """Compacted-occupancy snapshot of fpset key columns.
+
+    ``cols`` are K uint32 columns of ``cap + 1`` slots (the trailing
+    trash row is dropped), either 1-D (single device) or 2-D
+    ``[N, cap + 1]`` (one row per shard).  Only occupied (non-all-
+    SENTINEL) slots are stored: their keys per column plus the slot
+    index, with per-shard counts so ragged occupancy round-trips.
+    """
+    cs = [np.asarray(c, np.uint32) for c in cols]
+    ndim = cs[0].ndim
+    if ndim == 1:
+        cs = [c[None, :] for c in cs]
+    cap = cs[0].shape[1] - 1
+    body = [c[:, :cap] for c in cs]
+    empty = body[0] == _SENTINEL
+    for b in body[1:]:
+        empty &= b == _SENTINEL
+    occ = ~empty
+    out: Dict[str, np.ndarray] = {
+        f"{prefix}_tcap": np.int64(cap),
+        f"{prefix}_ndim": np.int64(ndim),
+    }
+    keys = [[] for _ in cs]
+    slots = []
+    cnts = []
+    for s in range(cs[0].shape[0]):
+        idx = np.flatnonzero(occ[s])
+        cnts.append(len(idx))
+        slots.append(idx.astype(np.int64))
+        for i, b in enumerate(body):
+            keys[i].append(b[s][idx])
+    out[f"{prefix}_cnt"] = np.asarray(cnts, np.int64)
+    out[f"{prefix}_slot"] = (
+        np.concatenate(slots) if slots else np.zeros((0,), np.int64)
+    )
+    for i, k in enumerate(keys):
+        out[f"{prefix}k{i}"] = (
+            np.concatenate(k) if k else np.zeros((0,), np.uint32)
+        )
+    return out
+
+
+def unpack_fpset(
+    d, ncols: int, prefix: str = "fp"
+) -> Tuple[np.ndarray, ...]:
+    """Rebuild full fpset columns (SENTINEL-filled, occupied slots
+    scattered back, trash row restored) from a :func:`pack_fpset`
+    frame.  Returns numpy arrays shaped exactly as saved (1-D or
+    ``[N, cap + 1]``); callers device_put them."""
+    cap = int(d[f"{prefix}_tcap"])
+    ndim = int(d[f"{prefix}_ndim"])
+    cnts = np.asarray(d[f"{prefix}_cnt"], np.int64)
+    slots = np.asarray(d[f"{prefix}_slot"], np.int64)
+    n_shards = len(cnts)
+    cols = tuple(
+        np.full((n_shards, cap + 1), _SENTINEL, np.uint32)
+        for _ in range(ncols)
+    )
+    off = 0
+    for s in range(n_shards):
+        n = int(cnts[s])
+        sl = slots[off: off + n]
+        for i in range(ncols):
+            cols[i][s, sl] = np.asarray(
+                d[f"{prefix}k{i}"][off: off + n], np.uint32
+            )
+        off += n
+    if ndim == 1:
+        cols = tuple(c[0] for c in cols)
+    return cols
+
+
+# --------------------------------------------- preemption-safe stops
+
+
+class PreemptionWatcher:
+    """SIGTERM/SIGINT -> "checkpoint at the next level boundary".
+
+    The TPU-VM preemption contract delivers SIGTERM with a short grace
+    window; an operator Ctrl-C deserves the same survivable exit.  The
+    first signal only sets :attr:`requested` — the engine finishes the
+    level it is on, writes a resumable frame, and returns a truncated
+    result with ``stop_reason="preempted"``.  A second SIGINT raises
+    KeyboardInterrupt immediately (the operator insists).
+
+    Usable as a context manager; installs handlers only when
+    ``enabled`` and on the main thread (signal handlers cannot be set
+    elsewhere — a checker driven from a worker thread simply runs
+    without preemption capture).
+    """
+
+    def __init__(self, enabled: bool = True, log=None):
+        self.enabled = enabled
+        self.requested = False
+        self._log = log
+        self._prev: Dict[int, object] = {}
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        if self.requested and signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        self.requested = True
+        name = signal.Signals(signum).name
+        msg = (
+            f"{name} received: checkpointing at the next level "
+            "boundary, then exiting resumably"
+        )
+        if self._log is not None:
+            self._log(msg)
+        else:
+            import sys
+
+            print(f"  {msg}", file=sys.stderr, flush=True)
+
+    def __enter__(self):
+        if (
+            self.enabled
+            and threading.current_thread() is threading.main_thread()
+        ):
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._prev[sig] = signal.signal(sig, self._handle)
+                except (ValueError, OSError):  # non-main thread/races
+                    break
+            else:
+                self._installed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._installed:
+            for sig, prev in self._prev.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):
+                    pass
+            self._installed = False
+        return False

@@ -1,0 +1,135 @@
+"""Deterministic fault injection — prove survivability, don't hope.
+
+Differential robustness tests need interrupted runs whose
+interruption point is exact and repeatable: "the process died at
+level 5", "HBM ran out at level 7", "the fpset overflowed a probe
+stage on flush 3".  This module turns the ``PTT_FAULT`` environment
+variable into synthetic faults fired at named host-side sites:
+
+    PTT_FAULT=oom@level:7              synthetic RESOURCE_EXHAUSTED
+    PTT_FAULT=fpset_fail@flush:3       fpset stage-overflow (fail-stop)
+    PTT_FAULT=kill@level:5             hard process death (os._exit 137)
+    PTT_FAULT=sigterm@level:4          SIGTERM to self (preemption drill)
+    PTT_FAULT=oom@level:7,kill@level:9 comma-separated specs compose
+
+Syntax: ``kind@site:count`` — ``site`` is a counter the engines
+advance (``level`` = the BFS level about to be expanded, ``flush`` =
+the flush sequence number), ``count`` the value at which the spec
+fires.  Each spec fires AT MOST ONCE per process: a run that recovers
+from an injected OOM and re-expands the same level must not be
+re-injected forever (mirroring the real world, where the recovery's
+degraded capacity is what prevents the repeat).
+
+Engines call :func:`poll` at their sites.  ``kill`` and ``sigterm``
+are performed inside :func:`poll` (the process dies / signals
+itself); every other kind is returned for the caller to realize in
+engine-appropriate form (``oom`` is raised by the engine as a
+:class:`FaultError` whose text contains ``RESOURCE_EXHAUSTED`` so it
+exercises the *same* handler as a real XLA allocation failure).
+
+Everything is inert unless ``PTT_FAULT`` is set — one short env read
+per poll, no parsing on the common path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Set, Tuple
+
+
+class FaultError(RuntimeError):
+    """An injected fault, raised by the engine at the injection site.
+    ``oom`` faults embed ``RESOURCE_EXHAUSTED`` in the message so the
+    engines' real out-of-memory handlers fire."""
+
+
+KINDS = ("oom", "fpset_fail", "kill", "sigterm")
+
+# parse cache keyed on the raw env value + set of fired spec indexes
+# (per process; a changed PTT_FAULT re-arms everything)
+_cache_raw: str = ""
+_cache_specs: List[Tuple[str, str, int]] = []
+_fired: Set[int] = set()
+
+
+def reset() -> None:
+    """Re-arm every spec (tests that reuse one process)."""
+    global _cache_raw
+    _cache_raw = ""
+    _fired.clear()
+
+
+def _specs() -> List[Tuple[str, str, int]]:
+    global _cache_raw, _cache_specs
+    raw = os.environ.get("PTT_FAULT", "")
+    if raw == _cache_raw:
+        return _cache_specs
+    specs: List[Tuple[str, str, int]] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            kind, rest = part.split("@", 1)
+            site, count = rest.split(":", 1)
+            kind, site, n = kind.strip(), site.strip(), int(count)
+        except ValueError:
+            raise ValueError(
+                f"bad PTT_FAULT spec {part!r} (want kind@site:count, "
+                f"e.g. oom@level:7)"
+            ) from None
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown PTT_FAULT kind {kind!r} (known: {KINDS})"
+            )
+        specs.append((kind, site, n))
+    _cache_raw = raw
+    _cache_specs = specs
+    _fired.clear()
+    return specs
+
+
+def active() -> bool:
+    return bool(os.environ.get("PTT_FAULT"))
+
+
+def poll(site: str, count: int) -> Tuple[str, ...]:
+    """Fire every armed spec matching ``(site, count)``.
+
+    ``kill`` exits the process here with status 137 (SIGKILL's shell
+    convention — a death no handler can soften, which is the point);
+    ``sigterm`` delivers SIGTERM to this process (the preemption
+    watcher then sees exactly what a TPU-VM preemption sends).  All
+    other kinds are returned for the engine to realize.
+    """
+    if not os.environ.get("PTT_FAULT"):
+        return ()
+    hits = []
+    for i, (kind, s, n) in enumerate(_specs()):
+        if i in _fired or s != site or n != count:
+            continue
+        _fired.add(i)
+        if kind == "kill":
+            import sys
+
+            print(
+                f"PTT_FAULT: kill@{site}:{count} — hard exit",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(137)
+        if kind == "sigterm":
+            import signal
+
+            os.kill(os.getpid(), signal.SIGTERM)
+            continue
+        hits.append(kind)
+    return tuple(hits)
+
+
+def oom_error(site: str, count: int) -> FaultError:
+    """The canonical injected-OOM exception (text matches the real
+    XLA allocator's RESOURCE_EXHAUSTED status prefix)."""
+    return FaultError(
+        f"RESOURCE_EXHAUSTED: injected fault oom@{site}:{count} "
+        "(PTT_FAULT)"
+    )

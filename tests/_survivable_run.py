@@ -1,0 +1,88 @@
+"""Subprocess driver for crash-resume differential tests.
+
+Runs one checker (device or sharded, CPU backend) on the shipped
+compaction config with a checkpoint path, printing a one-line JSON
+result on success.  Fault injection rides the PTT_FAULT env var set by
+the calling test — ``kill@level:k`` hard-exits 137 mid-run, leaving
+only the checkpoint frames behind, which is the whole point.
+
+Not collected by pytest (no ``test_`` prefix); invoked as
+``python -m tests._survivable_run`` from the repo root.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--engine", choices=["device", "sharded"],
+                    default="device")
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--invariant", default=None)
+    ap.add_argument("--every", type=int, default=2)
+    ap.add_argument("--max-states", type=int, default=200_000_000)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+    m = CompactionModel(pe.SHIPPED_CFG)
+    inv = (args.invariant,) if args.invariant else ()
+    if args.engine == "device":
+        from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+        ck = DeviceChecker(
+            m, invariants=inv, sub_batch=2048, visited_cap=1 << 16,
+            frontier_cap=1 << 15, max_states=args.max_states,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.every,
+        )
+    else:
+        from pulsar_tlaplus_tpu.engine.sharded_device import (
+            ShardedDeviceChecker,
+        )
+
+        ck = ShardedDeviceChecker(
+            m, n_devices=4, invariants=inv, sub_batch=512,
+            visited_cap=1 << 13, max_states=args.max_states,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.every,
+        )
+    r = ck.run(resume=args.resume)
+    print(
+        json.dumps(
+            {
+                "distinct_states": r.distinct_states,
+                "diameter": r.diameter,
+                "level_sizes": r.level_sizes,
+                "truncated": r.truncated,
+                "stop_reason": r.stop_reason,
+                "violation": r.violation,
+                "violation_gid": r.violation_gid,
+                "trace": (
+                    [repr(s) for s in r.trace]
+                    if r.trace is not None
+                    else None
+                ),
+                "trace_actions": (
+                    list(r.trace_actions)
+                    if r.trace_actions is not None
+                    else None
+                ),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,20 @@ counterexample-trace validation."""
 
 import random
 
+import jax
+import pytest
+
 from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+# Both sharded engines build on jax.shard_map (added after jax 0.4.37,
+# the container's version).  Known-environment failures are noise, not
+# signal: tier-1 SKIPS these tests where shard_map is absent — the real
+# host (and any jax >= 0.5) still runs them.
+needs_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="sharded engines need jax.shard_map (newer jax; container "
+    "jax 0.4.37 lacks it)",
+)
 
 
 def assert_valid_counterexample(c, trace, trace_actions, invariant):

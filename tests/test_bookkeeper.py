@@ -15,6 +15,7 @@ from pulsar_tlaplus_tpu.models.bookkeeper import (
     BookkeeperConstants,
     BookkeeperModel,
 )
+from tests.helpers import needs_shard_map
 
 SPEC_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -126,6 +127,7 @@ def test_durability_contract_boundary(module):
         cur = nxt[0]
 
 
+@needs_shard_map
 def test_sharded_counts_match():
     from pulsar_tlaplus_tpu.engine.sharded import ShardedChecker
 

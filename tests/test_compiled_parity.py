@@ -15,7 +15,7 @@ from pulsar_tlaplus_tpu.frontend.codegen import CompiledSpec
 from pulsar_tlaplus_tpu.frontend.loader import compaction_constants
 from pulsar_tlaplus_tpu.frontend.parser import parse_file
 from pulsar_tlaplus_tpu.ref import pyeval as pe
-from tests.helpers import SMALL_CONFIGS
+from tests.helpers import needs_shard_map, SMALL_CONFIGS
 
 REFERENCE_TLA = "/root/reference/compaction.tla"
 
@@ -30,6 +30,7 @@ def _compiled(module, c, invariants=()):
     return CompiledSpec(spec, invariants=invariants)
 
 
+@needs_shard_map
 def test_compiled_sharded_matches_oracle(module):
     """-compile -sharded: the device-resident sharded engine accepts a
     CompiledSpec and matches the oracle exactly on an 8-shard mesh."""
@@ -47,6 +48,7 @@ def test_compiled_sharded_matches_oracle(module):
 @pytest.mark.parametrize(
     "name", ["subscription", "bookkeeper", "georeplication"]
 )
+@needs_shard_map
 def test_compiled_sharded_original_specs(name):
     from pulsar_tlaplus_tpu.engine.interp_check import InterpChecker
     from pulsar_tlaplus_tpu.frontend.loader import bind_cfg

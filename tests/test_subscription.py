@@ -18,6 +18,7 @@ from pulsar_tlaplus_tpu.models.subscription import (
     SubscriptionConstants,
     SubscriptionModel,
 )
+from tests.helpers import needs_shard_map
 
 SPEC_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -135,6 +136,7 @@ def test_no_crash_config_is_exactly_once(module):
     assert ri.distinct_states == rm.distinct_states
 
 
+@needs_shard_map
 def test_sharded_counts_match():
     from pulsar_tlaplus_tpu.engine.sharded import ShardedChecker
 
