@@ -236,6 +236,27 @@ def parse_args(argv=None):
         help="resume the device run from --checkpoint instead of "
         "starting fresh (skips the host seed)",
     )
+    ap.add_argument(
+        "--telemetry", default=None, metavar="FILE",
+        help="write the structured run-event JSONL stream here "
+        "(docs/observability.md); scripts/telemetry_report.py turns "
+        "it into the BASELINE per-stage table and the BENCH keys",
+    )
+    ap.add_argument(
+        "--progress-every", type=float, default=None, metavar="SEC",
+        help="TLC-style heartbeat line every SEC seconds from the "
+        "last fetched stats snapshot (zero extra device syncs)",
+    )
+    ap.add_argument(
+        "--xprof", default=None, metavar="DIR",
+        help="capture a JAX profiler trace into DIR around the "
+        "--xprof-levels window (real-chip runs)",
+    )
+    ap.add_argument(
+        "--xprof-levels", default=None, metavar="LO:HI",
+        help="BFS level window for --xprof (e.g. 7:7 profiles the "
+        "deep level; default: the whole run)",
+    )
     return ap.parse_args(argv)
 
 
@@ -273,6 +294,14 @@ def main(argv=None):
     # candidates instead of per 8.9M).
     kw = dict(BENCH_CHECKER_KW)
     kw["max_states"] = args.max_states
+    xprof_window = None
+    if args.xprof_levels:
+        from pulsar_tlaplus_tpu.obs.telemetry import parse_level_window
+
+        try:
+            xprof_window = parse_level_window(args.xprof_levels)
+        except ValueError as e:
+            sys.exit(f"bench: --xprof-levels: {e}")
     ck = DeviceChecker(
         model,
         time_budget_s=args.budget_s,
@@ -281,6 +310,10 @@ def main(argv=None):
         visited_impl=args.visited,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
+        telemetry=args.telemetry,
+        heartbeat_s=args.progress_every,
+        xprof_dir=args.xprof,
+        xprof_levels=xprof_window,
         **kw,
     )
     t0 = time.time()
@@ -379,8 +412,11 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "unit": "states/sec/chip",
                 # machine-visible schema versioning (ADVICE r4):
                 # vs_baseline redefined in r4 to the 8x-extrapolated
-                # native baseline; bump this if its meaning changes again
-                "bench_schema": 2,
+                # native baseline (schema 2); schema 3 adds the
+                # telemetry/survivability key set (fpset_*, ckpt_*,
+                # stop_reason...) validated by
+                # scripts/check_telemetry_schema.py
+                "bench_schema": 3,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2
@@ -416,7 +452,12 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "hbm_recovered": getattr(r, "hbm_recovered", 0),
                 "ckpt_frames": ck.last_stats.get("ckpt_frames", 0),
                 "ckpt_bytes": ck.last_stats.get("ckpt_bytes", 0),
+                # frame-write stall seconds (BENCH_r07 ask): host time
+                # the run loop spent blocked gathering + writing frames
+                "ckpt_write_s": ck.last_stats.get("ckpt_write_s", 0.0),
                 "checkpoint": args.checkpoint,
+                "telemetry": args.telemetry,
+                "stats_fetches": ck.last_stats.get("stats_fetches"),
                 "sustained_last_level_sps": (
                     round(last_level_sps, 1)
                     if last_level_sps is not None else None
@@ -443,6 +484,17 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 ),
                 "fpset_failures": ck.last_stats.get("fpset_failures"),
                 "fpset_occupancy": ck.last_stats.get("fpset_occupancy"),
+                # zero-sync device counters (r8): candidate lanes after
+                # validity masking, duplicate ratio, worst flush depth
+                "fpset_valid_lanes": ck.last_stats.get(
+                    "fpset_valid_lanes"
+                ),
+                "fpset_duplicate_ratio": ck.last_stats.get(
+                    "fpset_duplicate_ratio"
+                ),
+                "fpset_max_probe_rounds": ck.last_stats.get(
+                    "fpset_max_probe_rounds"
+                ),
                 "engine": (
                     "device_bfs r6 (fpset HBM hash-table visited set — "
                     "no visited-width flush sort; frontier-window row "

@@ -157,6 +157,10 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         progress=True,
         metrics_path=args.metrics,
         visited_impl=args.visited,
+        telemetry=args.telemetry,
+        heartbeat_s=args.progress,
+        xprof_dir=args.xprof,
+        xprof_levels=args.xprof_window,
     )
     try:
         r = ck.run()
@@ -182,10 +186,14 @@ def _check_interp(args, module, spec_path, tlc_cfg, invariants):
             f"({'-interp forced' if args.interp else 'module not in the compiled registry'}); "
             "the interpreter path is exhaustive BFS only"
         )
-    if args.checkpoint or args.recover or args.metrics:
+    if (
+        args.checkpoint or args.recover or args.metrics
+        or args.telemetry or args.progress or args.xprof
+    ):
         sys.exit(
-            "tpu-tlc: -checkpoint/-recover/-metrics are not supported on "
-            "the generic-interpreter path yet"
+            "tpu-tlc: -checkpoint/-recover/-metrics/-telemetry/"
+            "-progress/-xprof are not supported on the generic-"
+            "interpreter path yet"
         )
     if tlc_cfg.properties:
         print(
@@ -274,6 +282,30 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
     the single-device checker — all via the generic model protocol."""
     from pulsar_tlaplus_tpu.utils.render import render_trace
 
+    if args.xprof and (
+        args.liveness_property or args.simulate or args.sharded
+        or args.engine != "device"
+    ):
+        # never let a user wait out a long run believing a profile was
+        # collected: level-windowed tracing exists only on the
+        # single-chip device engine (-profile traces any whole check)
+        print(
+            "tpu-tlc: note: -xprof is only supported on the "
+            "single-chip device engine; no trace will be captured "
+            "(use -profile DIR to trace the whole check)",
+            file=sys.stderr,
+        )
+    if (args.telemetry or args.progress) and (
+        args.liveness_property or args.simulate
+    ):
+        # same promise: flags that do nothing must say so, not silently
+        # drop (the BFS engines are the only telemetry emitters today)
+        print(
+            "tpu-tlc: note: -telemetry/-progress are not wired into "
+            "the liveness/simulation engines yet; no stream or "
+            "heartbeat will be produced for this run",
+            file=sys.stderr,
+        )
     if args.liveness_property:
         from pulsar_tlaplus_tpu.engine.liveness import LivenessChecker
 
@@ -338,6 +370,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             checkpoint_path=args.checkpoint,
             n_slices=args.slices,
             visited_impl=args.visited,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
         )
     elif args.sharded:
         if args.sharded_engine == "device":
@@ -365,6 +399,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             dedup_mode=args.sharded_dedup,
             metrics_path=args.metrics,
             checkpoint_path=args.checkpoint,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
         )
     elif args.engine == "device":
         # the flagship single-chip engine (the one every BENCH runs) —
@@ -384,6 +420,10 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             metrics_path=args.metrics,
             visited_impl=args.visited,
             checkpoint_path=args.checkpoint,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
+            xprof_dir=args.xprof,
+            xprof_levels=args.xprof_window,
         )
     else:
         from pulsar_tlaplus_tpu.engine.bfs import Checker
@@ -397,6 +437,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             progress=True,
             metrics_path=args.metrics,
             checkpoint_path=args.checkpoint,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
         )
     if args.recover and (
         not args.checkpoint or not os.path.exists(args.checkpoint)
@@ -521,6 +563,39 @@ def main(argv=None):
         "-metrics", help="write per-level JSONL metrics to this file"
     )
     pc.add_argument(
+        "-telemetry",
+        metavar="FILE",
+        help="write the structured run-event stream (versioned JSONL: "
+        "run header, per-level progress, per-flush fpset metrics, "
+        "checkpoint frames, recovery/fault events, final result) to "
+        "this file; see docs/observability.md",
+    )
+    pc.add_argument(
+        "-progress",
+        type=float,
+        default=None,
+        metavar="SEC",
+        help="TLC-style periodic progress line every SEC seconds "
+        "(default off): states generated/distinct, frontier depth, "
+        "states/sec, fpset occupancy, and ETA-to-capacity — reported "
+        "from the last fetched stats snapshot, adding zero device "
+        "syncs",
+    )
+    pc.add_argument(
+        "-xprof",
+        metavar="DIR",
+        help="capture a JAX profiler trace into DIR around the "
+        "-xprof-levels window of the device engine (real-chip runs; "
+        "-profile traces the WHOLE check instead)",
+    )
+    pc.add_argument(
+        "-xprof-levels",
+        metavar="LO:HI",
+        default=None,
+        help="BFS level window for -xprof (e.g. 6:7; default: the "
+        "whole run)",
+    )
+    pc.add_argument(
         "-checkpoint",
         help="checkpoint file (.npz): level-boundary frames are written "
         "atomically every few levels; SIGTERM/SIGINT checkpoint at the "
@@ -565,6 +640,23 @@ def main(argv=None):
     pc.add_argument("-maxstates", type=int, default=200_000_000)
     args = p.parse_args(argv)
 
+    args.xprof_window = None
+    if args.xprof_levels:
+        from pulsar_tlaplus_tpu.obs.telemetry import parse_level_window
+
+        try:
+            args.xprof_window = parse_level_window(args.xprof_levels)
+        except ValueError as e:
+            sys.exit(f"tpu-tlc: -xprof-levels: {e}")
+    if args.profile and args.xprof:
+        # JAX allows one active profiler trace: the whole-check trace
+        # would collide with the level window mid-run, aborting a run
+        # that may be hours in
+        sys.exit(
+            "tpu-tlc: -profile and -xprof are mutually exclusive "
+            "(both drive jax.profiler; pick the whole-check trace OR "
+            "the level window)"
+        )
     if args.cpu:
         import jax
 

@@ -39,6 +39,7 @@ from pulsar_tlaplus_tpu.engine.core import (
     dedup_core_hash,
 )
 from pulsar_tlaplus_tpu.engine.statelog import FileLog, MemoryLog
+from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.ops import hashtable
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.ref import pyeval
@@ -94,6 +95,8 @@ class Checker:
         keep_log: bool = False,
         state_log_path: Optional[str] = None,
         dedup: str = "hash",
+        telemetry=None,
+        heartbeat_s: Optional[float] = None,
     ):
         if dedup not in ("hash", "sort"):
             raise ValueError(f"dedup must be 'hash' or 'sort': {dedup}")
@@ -124,6 +127,16 @@ class Checker:
         self._cap = visited_cap
         self._jit_cache: Dict[Tuple[str, int], object] = {}
         self._unpack1 = jax.jit(self.layout.unpack)
+        # unified telemetry (round 8): JSONL stream + progress heartbeat
+        self._telemetry_arg = telemetry
+        self.tel = obs.NULL
+        self.heartbeat_s = heartbeat_s
+        self._run_id: Optional[str] = None
+        self._snap: Dict[str, object] = {}
+        self._resume_meta: Dict[str, object] = {}
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
 
     # ------------------------------------------------------------------
     # jitted steps (cached per visited capacity tier)
@@ -254,6 +267,7 @@ class Checker:
         is shared with the device engines (utils/ckpt.py)."""
         from pulsar_tlaplus_tpu.utils import ckpt
 
+        t_stall = time.perf_counter()
         log = rs.log
         if isinstance(log, FileLog):
             log.sync()
@@ -267,7 +281,7 @@ class Checker:
                 parent=log.parents(),
                 action=log.actions(),
             )
-        ckpt.save_frame(
+        nbytes, write_s = ckpt.save_frame(
             self.checkpoint_path,
             self._config_sig(),
             dict(
@@ -282,6 +296,25 @@ class Checker:
                 **log_arrays,
             ),
             wall_s=time.time() - rs.t0,
+            meta={
+                "run_id": self._run_id,
+                "frame_seq": self._ckpt_frames + 1,
+                "level": len(rs.level_sizes),
+                "engine": "bfs_host",
+            },
+        )
+        stall_s = time.perf_counter() - t_stall
+        self._ckpt_frames += 1
+        self._ckpt_bytes += nbytes
+        self._ckpt_write_s += stall_s
+        self.tel.emit(
+            "ckpt_frame",
+            frame_seq=self._ckpt_frames,
+            bytes=nbytes,
+            write_s=round(write_s, 3),
+            stall_s=round(stall_s, 3),
+            level=len(rs.level_sizes),
+            distinct_states=rs.n_total,
         )
 
     def load_checkpoint(self):
@@ -295,10 +328,67 @@ class Checker:
         )
 
     def run(self, resume: bool = False) -> CheckerResult:
+        rid = obs.new_run_id()
+        self.tel = obs.as_telemetry(self._telemetry_arg, run_id=rid)
+        self._run_id = self.tel.run_id or rid
+        self._snap = {"distinct_states": 0}
+        self._resume_meta = {}
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
+        hb = None
+        if self.heartbeat_s:
+            hb = obs.Heartbeat(
+                self.heartbeat_s, self._snap, telemetry=self.tel,
+                capacity=self.max_states,
+            )
+        try:
+            if hb is not None:
+                hb.start()
+            return self._run_impl(resume)
+        except BaseException as e:
+            self.tel.emit("error", error=repr(e)[:300])
+            raise
+        finally:
+            if hb is not None:
+                hb.stop()
+            if obs.owns_stream(self._telemetry_arg):
+                self.tel.close()
+            self.tel = obs.NULL
+
+    def _emit_header(self, resume: bool):
+        if not self.tel.enabled:
+            return
+        try:
+            dev = str(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — headers must never kill a run
+            dev = "unknown"
+        f = dict(
+            engine="bfs_host",
+            device=dev,
+            visited_impl=self.dedup_mode,
+            config_sig=self._config_sig(),
+            wall_unix=round(time.time(), 3),
+            max_states=self.max_states,
+            invariants=list(self.invariant_names),
+            resume=resume,
+        )
+        rm = self._resume_meta
+        if resume and rm:
+            if rm.get("run_id"):
+                f["resume_of"] = rm["run_id"]
+            if rm.get("frame_seq") is not None:
+                f["resume_frame_seq"] = rm["frame_seq"]
+        self.tel.emit("run_header", **f)
+
+    def _run_impl(self, resume: bool = False) -> CheckerResult:
         rs = _RunState()
         rs.t0 = time.time()
         if resume:
+            from pulsar_tlaplus_tpu.utils import ckpt
+
             d = self.load_checkpoint()
+            self._resume_meta = ckpt.frame_meta(d)
             if "wall_s" in d:
                 # carry cumulative wall time across resume so wall_s /
                 # states_per_sec stay meaningful for the whole run
@@ -329,7 +419,9 @@ class Checker:
                 f"{rs.n_total} states, frontier {len(rs.frontier)}",
             )
             self._rewind_metrics(len(rs.level_sizes))
+            self._emit_header(resume=True)
             return self._bfs_loop(rs)
+        self._emit_header(resume=False)
         if self.dedup_mode == "hash":
             rs.vk = hashtable.empty_table(self._cap)
         else:
@@ -403,11 +495,24 @@ class Checker:
         level, mirroring TLC's progress lines (states/sec, queue depth).
         ``frontier`` is the queue depth at level start (states expanded);
         ``new_states`` is the discovery count (= next level's depth)."""
+        wall = time.time() - rs.t0
+        self._snap.update(
+            level=len(rs.level_sizes),
+            frontier=int(len(rs.frontier)),
+            distinct_states=rs.n_total,
+        )
+        self.tel.emit(
+            "level",
+            level=len(rs.level_sizes),
+            new_states=int(level_count),
+            distinct_states=rs.n_total,
+            frontier=int(len(rs.frontier)),
+            wall_s=round(wall, 3),
+            states_per_sec=round(rs.n_total / max(wall, 1e-9), 1),
+        )
         if not self.metrics_path:
             return
         import json
-
-        wall = time.time() - rs.t0
         with open(self.metrics_path, "a") as f:
             f.write(
                 json.dumps(
@@ -451,6 +556,25 @@ class Checker:
             res.trace, res.trace_actions = build_trace(
                 self.model, self._unpack1, gid, rs.log
             )
+        self.tel.emit(
+            "result",
+            distinct_states=rs.n_total,
+            diameter=len(rs.level_sizes),
+            wall_s=round(wall, 3),
+            states_per_sec=round(rs.n_total / max(wall, 1e-9), 1),
+            truncated=truncated,
+            stop_reason=res.stop_reason,
+            violation=res.violation,
+            violation_gid=res.violation_gid,
+            deadlock=res.deadlock,
+            level_sizes=[int(x) for x in rs.level_sizes],
+            stats={
+                "ckpt_frames": self._ckpt_frames,
+                "ckpt_bytes": self._ckpt_bytes,
+                "ckpt_write_s": round(self._ckpt_write_s, 3),
+                "visited_cap": self._cap,
+            },
+        )
         return res
 
     def _insert_initial(self, rs) -> Optional[CheckerResult]:

@@ -57,6 +57,7 @@ import numpy as np
 from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
+from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.utils import ckpt, device, faults
 from pulsar_tlaplus_tpu.utils.aot_cache import ajit
 from pulsar_tlaplus_tpu.ops import dedup, fpset
@@ -64,6 +65,15 @@ from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
 BIG = jnp.int32(2**31 - 1)
+
+# Zero-sync device counters (round 8): the fpset metrics vector rides
+# the ONE hot-path stats fetch — [flushes, probe_rounds, failures,
+# valid_lanes, max_probe_rounds].  valid_lanes is the candidate count
+# after validity masking (the duplicate-rate denominator the host
+# cannot know without a sync); max_probe_rounds is the worst flush's
+# probe depth (a running max, not a sum).  Pre-r8 checkpoint frames
+# carry the 3-wide prefix and restore zero-padded.
+FPM_N = 5
 
 
 class _HbmExhausted(Exception):
@@ -116,6 +126,10 @@ class DeviceChecker:
         visited_impl: str = "fpset",
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 5,
+        telemetry=None,
+        heartbeat_s: Optional[float] = None,
+        xprof_dir: Optional[str] = None,
+        xprof_levels: Optional[Tuple[int, int]] = None,
     ):
         self.model = model
         self.layout = model.layout
@@ -302,9 +316,31 @@ class DeviceChecker:
         self._flush_seq = 0
         self._jits: Dict[tuple, object] = {}
         self.last_stats: Dict[str, float] = {}
+        # telemetry (round 8): a path or obs.telemetry.Telemetry; the
+        # stream is opened per run() with a fresh run_id, and the
+        # heartbeat reports from ``_snap`` — the last fetched stats
+        # snapshot — so neither adds a device sync
+        self._telemetry_arg = telemetry
+        self.tel = obs.NULL
+        self.heartbeat_s = heartbeat_s
+        self.xprof_dir = xprof_dir
+        self.xprof_levels = (
+            tuple(int(x) for x in xprof_levels) if xprof_levels else None
+        )
+        self._xprof_on = False
+        self._xprof_done = False
+        self._run_id: Optional[str] = None
+        self._snap: Dict[str, object] = {}
+        self._fetch_n = 0
+        self._ckpt_write_s = 0.0
+        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._resume_meta: Dict[str, object] = {}
         # PTT_STAGE_TIMING=1: drain after every dispatch and charge the
-        # wait to per-stage counters (serializes the pipeline; for
-        # profiling only, not the headline path)
+        # wait to per-stage counters — the LEGACY differential mode
+        # (serializes the pipeline; each drain pays one tunnel RTT,
+        # which the report layer subtracts via ``rtt_s``).  Dispatch
+        # counts (``stage_<name>_n``) are free host-side counters and
+        # ride regardless.
         self._stage_timing = os.environ.get(
             "PTT_STAGE_TIMING", "0"
         ) not in ("", "0")
@@ -324,20 +360,25 @@ class DeviceChecker:
             print(f"  {msg}", file=sys.stderr, flush=True)
 
     def _stage_mark(self, name: str, out):
-        """Stage-timing barrier: drain ``out`` and charge the wait to
-        ``stage_<name>_s`` in ``last_stats`` (one fetch is the only
-        reliable completion barrier on the tunnel backend).  Includes
-        one ~130 ms tunnel RTT per call — subtract ``stage_<name>_n``
-        x RTT when reading the numbers."""
+        """Per-stage accounting.  Dispatch counts (``stage_<name>_n``)
+        are free host-side counters and always ride.  Under
+        ``PTT_STAGE_TIMING=1`` — the legacy differential mode — this
+        also drains ``out`` and charges the wait to ``stage_<name>_s``
+        (one fetch is the only reliable completion barrier on the
+        tunnel backend), serializing the pipeline.  Each drain pays one
+        ~130 ms tunnel RTT; ``rtt_s`` (probed once at warmup) is in
+        ``last_stats`` so the report layer subtracts ``stage_<name>_n
+        x rtt_s`` — raw ``stage_<name>_s`` values overstate device
+        time."""
+        self.last_stats[f"stage_{name}_n"] = (
+            self.last_stats.get(f"stage_{name}_n", 0) + 1
+        )
         if not self._stage_timing:
             return out
         t0 = time.time()
         device.drain(out)
         self.last_stats[f"stage_{name}_s"] = (
             self.last_stats.get(f"stage_{name}_s", 0.0) + time.time() - t0
-        )
-        self.last_stats[f"stage_{name}_n"] = (
-            self.last_stats.get(f"stage_{name}_n", 0) + 1
         )
         return out
 
@@ -545,7 +586,9 @@ class DeviceChecker:
         accumulator order (min-lane-wins == the sort-merge's lowest-
         slot-wins, so gid assignment is IDENTICAL to the legacy flush),
         feeding the unchanged append.  ``fpm`` accumulates the
-        per-flush metrics [flushes, probe_rounds, failures] on device;
+        per-flush metrics [flushes, probe_rounds, failures,
+        valid_lanes, max_probe_rounds] on device (:data:`FPM_N`) so
+        they ride the one hot-path stats fetch — zero extra syncs;
         failures (stage overflow / probe limit) surface at the next
         stats fetch as a hard error — states were dropped, the run
         cannot continue honestly."""
@@ -565,8 +608,14 @@ class DeviceChecker:
                 tc, ak, valid
             )
             n_new = jnp.sum(is_new.astype(jnp.int32))
-            fpm = fpm + jnp.stack(
-                [jnp.int32(1), rounds, n_failed]
+            fpm = jnp.stack(
+                [
+                    fpm[0] + 1,
+                    fpm[1] + rounds,
+                    fpm[2] + n_failed,
+                    fpm[3] + jnp.sum(valid.astype(jnp.int32)),
+                    jnp.maximum(fpm[4], rounds),
+                ]
             )
             return (*tc2, n_new, is_new.astype(jnp.uint32), fpm)
 
@@ -891,7 +940,15 @@ class DeviceChecker:
                         jnp.min(jnp.where(bad, gid_base + lane, BIG))
                     )
                 viol = jnp.minimum(viol, jnp.stack(vnew))
-            fpm = fpm + jnp.stack([jnp.int32(1), rounds, n_failed])
+            fpm = jnp.stack(
+                [
+                    fpm[0] + 1,
+                    fpm[1] + rounds,
+                    fpm[2] + n_failed,
+                    fpm[3] + jnp.sum(valid.astype(jnp.int32)),
+                    jnp.maximum(fpm[4], rounds),
+                ]
+            )
             return (
                 *tc2,
                 n_visited + jnp.sum(is_new.astype(jnp.int32)),
@@ -1233,7 +1290,7 @@ class DeviceChecker:
         seed_tbl = None
         if fpmode:
             tc = fpset.empty_cols(self.TCAP, K)
-            fpm0 = jnp.zeros((3,), jnp.int32)
+            fpm0 = jnp.zeros((FPM_N,), jnp.int32)
             out = self._fpflush_jit()(*tc, *ak, jnp.int32(0), fpm0)
             drain(out)
             mark("flush")
@@ -1268,7 +1325,7 @@ class DeviceChecker:
         if fpmode:
             drain(
                 self._stats_jit()(
-                    jnp.int32(0), BIG, viol0, jnp.zeros((3,), jnp.int32)
+                    jnp.int32(0), BIG, viol0, jnp.zeros((FPM_N,), jnp.int32)
                 )
             )
         else:
@@ -1288,7 +1345,7 @@ class DeviceChecker:
                         *seed_tbl,
                         z((self.SEED_CHUNK, self.W), jnp.uint32),
                         jnp.int32(0), jnp.int32(0), viol0,
-                        jnp.int32(0), jnp.zeros((3,), jnp.int32),
+                        jnp.int32(0), jnp.zeros((FPM_N,), jnp.int32),
                     )
                 )
             else:
@@ -1317,7 +1374,14 @@ class DeviceChecker:
             if warm_pack is not None:
                 warm_pack()
             mark("seed")
-        return time.time() - t0
+        compile_s = time.time() - t0
+        # one-time tunnel RTT probe, AFTER the compile clock stops (it
+        # is a measurement, not a compile — ~3 round trips must not
+        # inflate compile_warmup_s): the report layer subtracts
+        # ``stage_<name>_n x rtt_s`` from the legacy PTT_STAGE_TIMING
+        # barrier timings (docs/observability.md)
+        self.last_stats["rtt_s"] = round(obs.measure_rtt(), 4)
+        return compile_s
 
     def run(self, seed=None, resume: bool = False) -> CheckerResult:
         """``seed``: optional host-enumerated BFS prefix
@@ -1337,9 +1401,39 @@ class DeviceChecker:
         self._hbm_recovered = 0
         self._ckpt_frames = 0
         self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
         self._recover_armed = False
         self._headroom_frozen = False
+        self._fetch_n = 0
+        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._resume_meta = {}
+        self._xprof_on = False
+        self._xprof_done = False
         self.group = self._group0
+        # telemetry stream: fresh run_id per run() (frames embed it, so
+        # a resumed run can link back to the writer of its frame)
+        rid = obs.new_run_id()
+        self.tel = obs.as_telemetry(self._telemetry_arg, run_id=rid)
+        self._run_id = self.tel.run_id or rid
+        self._snap = {"distinct_states": 0}
+        # the legacy stage-timing mode needs the RTT baseline even when
+        # the caller skipped warmup() (report subtracts n x rtt)
+        if self._stage_timing and "rtt_s" not in self.last_stats:
+            self.last_stats["rtt_s"] = round(obs.measure_rtt(), 4)
+        hb = None
+        if self.heartbeat_s:
+            hb = obs.Heartbeat(
+                self.heartbeat_s, self._snap, telemetry=self.tel,
+                capacity=self.SCAP,
+            )
+        if self.tel.enabled:
+            # crash breadcrumbs: fault events flush BEFORE the fault
+            # fires (kill sites leave no other trace)
+            faults.set_observer(
+                lambda kind, site, count: self.tel.emit(
+                    "fault", kind=kind, site=site, count=count
+                )
+            )
         # preemption-safe shutdown (TPU-VM contract): SIGTERM/SIGINT
         # request a checkpoint at the next level boundary; only armed
         # when there is a frame path to write to
@@ -1349,9 +1443,91 @@ class DeviceChecker:
         self._watcher = watcher
         try:
             with watcher:
+                if hb is not None:
+                    hb.start()
                 return self._run(t0, seed, resume)
+        except BaseException as e:
+            # the stream must tell WHY it ends when no result record
+            # will follow (probe overflow, OOM without a frame, ^C ^C)
+            self.tel.emit("error", error=repr(e)[:300])
+            raise
         finally:
+            if hb is not None:
+                hb.stop()
+            faults.set_observer(None)
+            self._xprof_close()
             self._watcher = None
+            if obs.owns_stream(self._telemetry_arg):
+                self.tel.close()
+            self.tel = obs.NULL
+
+    def _emit_header(self, resume: bool):
+        """The run-header record: config signature, device, engine —
+        plus, on resume, the writer identity of the frame being resumed
+        (``resume_of`` / ``resume_frame_seq``) so stream files chain."""
+        if not self.tel.enabled:
+            return
+        try:
+            dev = str(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — headers must never kill a run
+            dev = "unknown"
+        f = dict(
+            engine="device_bfs",
+            device=dev,
+            visited_impl=self.visited_impl,
+            config_sig=self._config_sig(),
+            wall_unix=round(time.time(), 3),
+            max_states=self.SCAP,
+            sub_batch=self.G,
+            flush_factor=self.FLUSH,
+            key_cols=self.K,
+            key_exact=bool(self.keys.exact),
+            rows_window=self.rows_window,
+            invariants=list(self.invariant_names),
+            resume=resume,
+        )
+        rm = self._resume_meta
+        if resume and rm:
+            if rm.get("run_id"):
+                f["resume_of"] = rm["run_id"]
+            if rm.get("frame_seq") is not None:
+                f["resume_frame_seq"] = rm["frame_seq"]
+            if rm.get("level") is not None:
+                f["resume_level"] = rm["level"]
+        self.tel.emit("run_header", **f)
+
+    # ------------------------------------------------------ xprof hooks
+
+    def _xprof_tick(self, level_next: int):
+        """Start/stop the JAX profiler trace around the configured
+        level window (``xprof_levels=(lo, hi)``; no window = the whole
+        run).  Real-chip usage: docs/observability.md."""
+        if not self.xprof_dir:
+            return
+        lo, hi = self.xprof_levels or (0, 1 << 30)
+        if self._xprof_on and level_next > hi:
+            self._xprof_close()
+        if (
+            not self._xprof_on
+            and not self._xprof_done
+            and lo <= level_next <= hi
+        ):
+            jax.profiler.start_trace(self.xprof_dir)
+            self._xprof_on = True
+            self.tel.emit(
+                "xprof", action="start", level=level_next,
+                dir=self.xprof_dir,
+            )
+
+    def _xprof_close(self):
+        if not self._xprof_on:
+            return
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._xprof_on = False
+            self._xprof_done = True  # one window per run
+        self.tel.emit("xprof", action="stop", dir=self.xprof_dir)
 
     def _run(self, t0, seed, resume) -> CheckerResult:
         if resume:
@@ -1364,11 +1540,13 @@ class DeviceChecker:
             ) = self._restore_frame()
             t0 = time.time() - saved_wall
             self._recover_armed = True  # the on-disk frame is valid
+            self._emit_header(resume=True)
             stats = self._fetch(st)
             return self._run_recoverable(
                 t0, bufs, st, rb, level_sizes, level_base, nf, stats
             )
         m = self.model
+        self._emit_header(resume=False)
         n_inv = len(self.invariant_names)
         K = self.K
         bufs = {
@@ -1398,7 +1576,7 @@ class DeviceChecker:
         if fpmode:
             # device-accumulated fpset metrics [flushes, probe rounds,
             # failures] — ride the regular stats fetch
-            st["fpm"] = jnp.zeros((3,), jnp.int32)
+            st["fpm"] = jnp.zeros((FPM_N,), jnp.int32)
 
         # frontier-window state: gid of rows[0], and whether row writes
         # are still landing in the window (False = diverted to scratch;
@@ -1467,7 +1645,10 @@ class DeviceChecker:
 
     def _fetch(self, st):
         """One stats fetch (the only hot-path host sync): returns the
-        numpy stats vector and fail-stops on fpset probe overflow."""
+        numpy stats vector and fail-stops on fpset probe overflow.
+        Every zero-sync device counter (:data:`FPM_N`) rides this
+        fetch; the heartbeat snapshot and the per-flush telemetry
+        deltas update here — nothing else ever syncs."""
         tf = time.time()
         stats_fn = self._stats_jit()
         fpmode = self.visited_impl == "fpset"
@@ -1483,9 +1664,17 @@ class DeviceChecker:
                 stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
             )
         self._host_wait_s += time.time() - tf
+        self._fetch_n += 1
+        nv = int(out[0])
+        self._snap["distinct_states"] = nv
         if fpmode:
             n_inv = len(self.invariant_names)
             self._last_fpm = out[2 + n_inv:]
+            self._snap["occupancy"] = nv / max(self.TCAP, 1)
+            if len(self._last_fpm) >= FPM_N:
+                # TLC's "states generated": candidate lanes examined
+                self._snap["generated"] = int(self._last_fpm[3])
+            self._emit_flush_event(nv)
             if self._last_fpm[2]:
                 # probe overflow: lanes were dropped by flushes
                 # already appended — the counts cannot be trusted,
@@ -1497,6 +1686,29 @@ class DeviceChecker:
                     "contract)"
                 )
         return out
+
+    def _emit_flush_event(self, nv: int):
+        """One telemetry record per stats fetch covering the flushes
+        since the previous fetch (deltas of the device-accumulated
+        counters) — per-flush visibility without per-flush syncs."""
+        if not self.tel.enabled or self._last_fpm is None:
+            return
+        cur = np.asarray(self._last_fpm[:FPM_N], np.int64)
+        d = cur - self._fpm_prev
+        if d[0] <= 0:
+            return
+        self._fpm_prev = cur
+        self.tel.emit(
+            "flush",
+            flushes=int(d[0]),
+            probe_rounds=int(d[1]),
+            failures=int(d[2]),
+            valid_lanes=int(d[3]),
+            avg_probe_rounds=round(int(d[1]) / max(int(d[0]), 1), 2),
+            max_probe_rounds=int(cur[4]) if len(cur) > 4 else 0,
+            occupancy=round(nv / max(self.TCAP, 1), 4),
+            distinct_states=nv,
+        )
 
     def _flush_acc(self, bufs, st, rb, n_acc, acc_base, is_init):
         """Dispatch the dedup + append for the current accumulator
@@ -1514,7 +1726,7 @@ class DeviceChecker:
             # synthetic stage overflow: account one dropped lane in
             # the device metrics — the next stats fetch fail-stops
             # exactly like a real probe overflow would
-            st["fpm"] = st["fpm"] + jnp.asarray([0, 0, 1], jnp.int32)
+            st["fpm"] = st["fpm"] + jnp.asarray([0, 0, 1, 0, 0], jnp.int32)
         if fpmode:
             out = self._stage_mark(
                 "flush",
@@ -1580,6 +1792,13 @@ class DeviceChecker:
             # worst-case transients) and freeze growth headroom
             self.group = max(1, self.group // 2)
             self._headroom_frozen = True
+            self.tel.emit(
+                "hbm_recovery",
+                recovery_n=self._hbm_recovered,
+                group=self.group,
+                distinct_states=last[0],
+                error=last[2][:200],
+            )
             self._log(
                 "HBM exhausted: recovering from the last "
                 f"checkpoint frame (recovery #{self._hbm_recovered}"
@@ -1647,6 +1866,7 @@ class DeviceChecker:
                         t0, nv, level_sizes, bufs, truncated=True,
                         stop_reason="preempted",
                     )
+            self._xprof_tick(len(level_sizes) + 1)
             if self._stage_timing:
                 self._log(
                     f"level start: nf={nf} windows={-(-nf // self.G)}"
@@ -1922,6 +2142,7 @@ class DeviceChecker:
             # device rows unusable — keep the previous (older but
             # valid) frame rather than overwrite it with garbage
             return False
+        t_stall = time.perf_counter()
         W = self.W
         lo = 0 if self.rows_window == "all" else level_base
         arrays = {
@@ -1934,7 +2155,7 @@ class DeviceChecker:
             "fpm": (
                 np.asarray(st["fpm"])
                 if self.visited_impl == "fpset"
-                else np.zeros((3,), np.int32)
+                else np.zeros((FPM_N,), np.int32)
             ),
             "parent": np.asarray(bufs["parent"][:nv]),
             "lane": np.asarray(bufs["lane"][:nv]),
@@ -1958,19 +2179,41 @@ class DeviceChecker:
                 # sorted columns: the first nv entries are the real
                 # keys (SENTINEL pad sorts behind every real key)
                 arrays[f"vk{i}"] = np.asarray(col[:nv])
-        nbytes = ckpt.save_frame(
+        nbytes, write_s = ckpt.save_frame(
             self.checkpoint_path, self._config_sig(), arrays,
             wall_s=time.time() - t0,
+            meta={
+                "run_id": self._run_id,
+                "frame_seq": self._ckpt_frames + 1,
+                "level": len(level_sizes),
+                "engine": "device_bfs",
+            },
         )
+        # the frame-write STALL is everything the run loop was blocked
+        # on here: the D2H gathers above plus the compressed write
+        stall_s = time.perf_counter() - t_stall
         self._ckpt_frames += 1
         self._ckpt_bytes += nbytes
+        self._ckpt_write_s += stall_s
         self._recover_armed = True
         self.last_stats.update(
-            ckpt_frames=self._ckpt_frames, ckpt_bytes=self._ckpt_bytes
+            ckpt_frames=self._ckpt_frames,
+            ckpt_bytes=self._ckpt_bytes,
+            ckpt_write_s=round(self._ckpt_write_s, 3),
+        )
+        self.tel.emit(
+            "ckpt_frame",
+            frame_seq=self._ckpt_frames,
+            bytes=nbytes,
+            write_s=round(write_s, 3),
+            stall_s=round(stall_s, 3),
+            level=len(level_sizes),
+            distinct_states=nv,
         )
         self._log(
             f"checkpoint: level {len(level_sizes)}, {nv} states "
-            f"({nbytes >> 10} KiB) -> {self.checkpoint_path}"
+            f"({nbytes >> 10} KiB, {stall_s:.2f}s stall) -> "
+            f"{self.checkpoint_path}"
         )
         return True
 
@@ -1978,6 +2221,10 @@ class DeviceChecker:
         """Rebuild device buffers + level frame from the checkpoint;
         returns (bufs, st, rb, level_sizes, level_base, nf, wall_s)."""
         d = ckpt.load_frame(self.checkpoint_path, self._config_sig())
+        # writer identity (run_id / frame_seq) for the resume header —
+        # the telemetry stream of the resumed run links back to the
+        # prior run's last ckpt_frame event
+        self._resume_meta = ckpt.frame_meta(d)
         K, W = self.K, self.W
         nv = int(d["n_visited"])
         level_sizes = [int(x) for x in d["level_sizes"]]
@@ -2074,7 +2321,15 @@ class DeviceChecker:
             "viol": jnp.full((n_inv,), int(BIG), jnp.int32),
         }
         if self.visited_impl == "fpset":
-            st["fpm"] = jnp.asarray(np.asarray(d["fpm"], np.int32))
+            # pre-r8 frames carry the 3-wide fpm prefix; zero-pad the
+            # new counters (valid_lanes / max_probe_rounds restart)
+            old = np.asarray(d["fpm"], np.int32).reshape(-1)
+            fpm = np.zeros((FPM_N,), np.int32)
+            fpm[: min(len(old), FPM_N)] = old[:FPM_N]
+            st["fpm"] = jnp.asarray(fpm)
+            # flush telemetry deltas continue from the frame's counts,
+            # not from zero (a resumed run must not re-report them)
+            self._fpm_prev = fpm.astype(np.int64)
         if "hbm_recovered" in d:
             self._hbm_recovered = max(
                 self._hbm_recovered, int(d["hbm_recovered"])
@@ -2125,11 +2380,23 @@ class DeviceChecker:
         """Every record is kept (duplicate state counts included) —
         rate consumers skip zero-delta tails themselves (bench.py
         sustained_rates)."""
+        wall = time.time() - t0
+        self._snap.update(
+            level=level, frontier=int(nf), distinct_states=int(nv)
+        )
+        self.tel.emit(
+            "level",
+            level=level,
+            new_states=int(level_count),
+            distinct_states=int(nv),
+            frontier=int(nf),
+            wall_s=round(wall, 3),
+            states_per_sec=round(nv / max(wall, 1e-9), 1),
+            host_wait_s=round(getattr(self, "_host_wait_s", 0.0), 3),
+        )
         if not self.metrics_path:
             return
         import json
-
-        wall = time.time() - t0
         with open(self.metrics_path, "a") as f:
             f.write(
                 json.dumps(
@@ -2206,11 +2473,26 @@ class DeviceChecker:
                 fpset_table_cap=self.TCAP,
                 fpset_occupancy=round(nv / max(self.TCAP, 1), 4),
             )
-        # survivability telemetry for bench artifacts (r7)
+            if len(self._last_fpm) >= FPM_N:
+                # zero-sync device counters (r8): candidate lanes after
+                # validity masking (duplicate-rate denominator) and the
+                # worst single flush's probe depth
+                vl = int(self._last_fpm[3])
+                self.last_stats.update(
+                    fpset_valid_lanes=vl,
+                    fpset_max_probe_rounds=int(self._last_fpm[4]),
+                    fpset_duplicate_ratio=round(
+                        max(1.0 - nv / vl, 0.0), 4
+                    ) if vl else None,
+                )
+        # survivability telemetry for bench artifacts (r7/r8)
         self.last_stats.update(
             hbm_recovered=self._hbm_recovered,
             ckpt_frames=self._ckpt_frames,
             ckpt_bytes=self._ckpt_bytes,
+            ckpt_write_s=round(self._ckpt_write_s, 3),
+            host_wait_s=round(getattr(self, "_host_wait_s", 0.0), 3),
+            stats_fetches=self._fetch_n,
         )
         res = CheckerResult(
             distinct_states=nv,
@@ -2244,4 +2526,26 @@ class DeviceChecker:
                 res.trace, res.trace_actions = self._trace(
                     bufs, gid, len(level_sizes) + 2
                 )
+        # the final stream record carries the whole last_stats dict
+        # (stage counters/timings, rtt_s, fpset_*, ckpt_*) — the report
+        # layer rebuilds the per-stage table and BENCH keys from it
+        self.tel.emit(
+            "result",
+            distinct_states=nv,
+            diameter=len(level_sizes),
+            wall_s=round(wall, 3),
+            states_per_sec=round(nv / max(wall, 1e-9), 1),
+            truncated=truncated,
+            stop_reason=res.stop_reason,
+            violation=res.violation,
+            violation_gid=res.violation_gid,
+            deadlock=res.deadlock,
+            hbm_recovered=self._hbm_recovered,
+            level_sizes=[int(x) for x in level_sizes],
+            fp_collision_prob=res.fp_collision_prob,
+            stats={
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self.last_stats.items()
+            },
+        )
         return res

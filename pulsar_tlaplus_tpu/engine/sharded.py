@@ -39,6 +39,7 @@ from pulsar_tlaplus_tpu.engine.core import (
     dedup_core,
     dedup_core_hash,
 )
+from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.ops import dedup, hashtable
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
 from pulsar_tlaplus_tpu.parallel.mesh import make_mesh
@@ -72,6 +73,8 @@ class ShardedChecker:
         metrics_path: Optional[str] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 5,
+        telemetry=None,
+        heartbeat_s: Optional[float] = None,
     ):
         if dedup_mode not in ("sort", "hash"):
             raise ValueError(
@@ -107,6 +110,16 @@ class ShardedChecker:
         self._dead_i = self._viol_i + (2 if dedup_mode == "hash" else 1)
         self._jit_cache: Dict[Tuple[str, int], object] = {}
         self._unpack1 = jax.jit(self.layout.unpack)
+        # unified telemetry (round 8)
+        self._telemetry_arg = telemetry
+        self.tel = obs.NULL
+        self.heartbeat_s = heartbeat_s
+        self._run_id: Optional[str] = None
+        self._snap: Dict[str, object] = {}
+        self._resume_meta: Dict[str, object] = {}
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
 
     # ------------------------------------------------------------------
     # device code
@@ -350,11 +363,23 @@ class ShardedChecker:
             f.writelines(kept)
 
     def _emit_metrics(self, t0, level, level_count, n_total, frontier_len):
+        wall = time.time() - t0
+        self._snap.update(
+            level=level, frontier=int(frontier_len),
+            distinct_states=int(n_total),
+        )
+        self.tel.emit(
+            "level",
+            level=level,
+            new_states=int(level_count),
+            distinct_states=int(n_total),
+            frontier=int(frontier_len),
+            wall_s=round(wall, 3),
+            states_per_sec=round(n_total / max(wall, 1e-9), 1),
+        )
         if not self.metrics_path:
             return
         import json
-
-        wall = time.time() - t0
         with open(self.metrics_path, "a") as f:
             f.write(
                 json.dumps(
@@ -382,8 +407,9 @@ class ShardedChecker:
         frame writer is shared with the device engines (utils/ckpt.py)."""
         from pulsar_tlaplus_tpu.utils import ckpt
 
+        t_stall = time.perf_counter()
         total = sum(len(f) for f in frontier)
-        ckpt.save_frame(
+        nbytes, write_s = ckpt.save_frame(
             self.checkpoint_path,
             self._config_sig(),
             dict(
@@ -411,6 +437,25 @@ class ShardedChecker:
                 action=log.actions(),
             ),
             wall_s=time.time() - t0,
+            meta={
+                "run_id": self._run_id,
+                "frame_seq": self._ckpt_frames + 1,
+                "level": len(level_sizes),
+                "engine": "sharded_host",
+            },
+        )
+        stall_s = time.perf_counter() - t_stall
+        self._ckpt_frames += 1
+        self._ckpt_bytes += nbytes
+        self._ckpt_write_s += stall_s
+        self.tel.emit(
+            "ckpt_frame",
+            frame_seq=self._ckpt_frames,
+            bytes=nbytes,
+            write_s=round(write_s, 3),
+            stall_s=round(stall_s, 3),
+            level=len(level_sizes),
+            distinct_states=int(np.asarray(n_visited).sum()),
         )
 
     def load_checkpoint(self):
@@ -421,6 +466,61 @@ class ShardedChecker:
         )
 
     def run(self, resume: bool = False) -> CheckerResult:
+        rid = obs.new_run_id()
+        self.tel = obs.as_telemetry(self._telemetry_arg, run_id=rid)
+        self._run_id = self.tel.run_id or rid
+        self._snap = {"distinct_states": 0}
+        self._resume_meta = {}
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
+        hb = None
+        if self.heartbeat_s:
+            hb = obs.Heartbeat(
+                self.heartbeat_s, self._snap, telemetry=self.tel,
+                capacity=self.max_states,
+            )
+        try:
+            if hb is not None:
+                hb.start()
+            return self._run_impl(resume)
+        except BaseException as e:
+            self.tel.emit("error", error=repr(e)[:300])
+            raise
+        finally:
+            if hb is not None:
+                hb.stop()
+            if obs.owns_stream(self._telemetry_arg):
+                self.tel.close()
+            self.tel = obs.NULL
+
+    def _emit_header(self, resume: bool):
+        if not self.tel.enabled:
+            return
+        try:
+            dev = str(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — headers must never kill a run
+            dev = "unknown"
+        f = dict(
+            engine="sharded_host",
+            device=dev,
+            n_devices=self.n_shards,
+            visited_impl=self.dedup_mode,
+            config_sig=self._config_sig(),
+            wall_unix=round(time.time(), 3),
+            max_states=self.max_states,
+            invariants=list(self.invariant_names),
+            resume=resume,
+        )
+        rm = self._resume_meta
+        if resume and rm:
+            if rm.get("run_id"):
+                f["resume_of"] = rm["run_id"]
+            if rm.get("frame_seq") is not None:
+                f["resume_frame_seq"] = rm["frame_seq"]
+        self.tel.emit("run_header", **f)
+
+    def _run_impl(self, resume: bool = False) -> CheckerResult:
         m = self.model
         nd = self.n_shards
         t0 = time.time()
@@ -522,10 +622,32 @@ class ShardedChecker:
                 res.trace, res.trace_actions = build_trace(
                     m, self._unpack1, gid, log
                 )
+            self.tel.emit(
+                "result",
+                distinct_states=n_total,
+                diameter=len(level_sizes),
+                wall_s=round(wall, 3),
+                states_per_sec=round(n_total / max(wall, 1e-9), 1),
+                truncated=truncated,
+                stop_reason=res.stop_reason,
+                violation=res.violation,
+                deadlock=res.deadlock,
+                level_sizes=[int(x) for x in level_sizes],
+                stats={
+                    "ckpt_frames": self._ckpt_frames,
+                    "ckpt_bytes": self._ckpt_bytes,
+                    "ckpt_write_s": round(self._ckpt_write_s, 3),
+                    "n_shards": self.n_shards,
+                },
+            )
             return res
 
         if resume:
+            from pulsar_tlaplus_tpu.utils import ckpt
+
             d = self.load_checkpoint()
+            self._resume_meta = ckpt.frame_meta(d)
+            self._emit_header(resume=True)
             if "wall_s" in d:
                 t0 = time.time() - float(d["wall_s"])
             self._cap = d["vk0"].shape[1] - (
@@ -547,6 +669,7 @@ class ShardedChecker:
             fgids = [fg_all[offs[i]: offs[i + 1]] for i in range(nd)]
             self._rewind_metrics(len(level_sizes))
         else:
+            self._emit_header(resume=False)
             # ---- level 1: initial states, routed to owners ----
             n_init = m.n_initial
             gen = jax.jit(

@@ -1,0 +1,12 @@
+"""Unified telemetry — structured run events, zero-sync device
+counters, TLC-style progress heartbeats, and per-stage reports.
+
+Two halves:
+
+- :mod:`pulsar_tlaplus_tpu.obs.telemetry` — the emission side every
+  engine (and the fpset) writes into: a versioned JSONL event stream,
+  the progress heartbeat thread, and the tunnel-RTT probe.
+- :mod:`pulsar_tlaplus_tpu.obs.report` — the aggregation side:
+  turns a stream back into the BASELINE.md per-stage table and the
+  BENCH_* artifact keys, RTT-corrected.
+"""

@@ -1,0 +1,225 @@
+"""Telemetry aggregation — JSONL stream -> per-stage table + BENCH keys.
+
+The consumers this serves (so bench numbers stop being hand-copied):
+
+- the BASELINE.md per-stage table (expand / flush / append splits, the
+  round-6 comparison shape) from a ``PTT_STAGE_TIMING=1`` run's stage
+  timings, **RTT-corrected**: the legacy barrier pays one tunnel round
+  trip per drain, so raw ``stage_<name>_s`` overstates device time by
+  ``stage_<name>_n x rtt_s`` — the probe measured once at warmup.
+  Subtraction happens HERE, not at collection (the raw numbers stay
+  honest in the stream; the correction is a documented view).
+- the ``fpset_*`` / ``ckpt_*`` BENCH artifact keys (BENCH_r06/r07
+  asks), read from the final ``result`` record's stats and
+  cross-checkable against the per-event stream.
+
+``scripts/telemetry_report.py`` is the CLI over this module.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+# canonical stage order for the per-stage table (matches BASELINE.md)
+STAGE_ORDER = ("expand", "flush", "append", "init", "shift")
+
+
+def load_events(path: str) -> Tuple[List[dict], List[str]]:
+    """Parse a stream; returns (events, errors).  A torn final line
+    (crash mid-write) is reported, never raised — a telemetry file
+    from a killed run must still aggregate."""
+    events: List[dict] = []
+    errors: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: unparseable ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"line {i}: not an object")
+                continue
+            events.append(rec)
+    return events, errors
+
+
+def _last(events: List[dict], kind: str) -> Optional[dict]:
+    for e in reversed(events):
+        if e.get("event") == kind:
+            return e
+    return None
+
+
+def header(events: List[dict]) -> Optional[dict]:
+    return _last(events, "run_header")
+
+
+def result(events: List[dict]) -> Optional[dict]:
+    return _last(events, "result")
+
+
+# ------------------------------------------------------- stage table
+
+
+def stage_split(events: List[dict]) -> Dict[str, dict]:
+    """Per-stage ``{name: {raw_s, n, device_s}}`` from the final
+    result's stats.  ``device_s`` is the RTT-corrected estimate
+    (``raw_s - n x rtt_s``, floored at 0); without timings (the
+    zero-sync default mode) only the dispatch counts ``n`` are
+    present and ``raw_s``/``device_s`` are None."""
+    res = result(events)
+    if res is None:
+        return {}
+    stats = res.get("stats", {}) or {}
+    rtt = stats.get("rtt_s") or 0.0
+    out: Dict[str, dict] = {}
+    names = set()
+    for k in stats:
+        if k.startswith("stage_") and (
+            k.endswith("_s") or k.endswith("_n")
+        ):
+            names.add(k[len("stage_"):].rsplit("_", 1)[0])
+    for name in names:
+        n = stats.get(f"stage_{name}_n")
+        raw = stats.get(f"stage_{name}_s")
+        dev = None
+        if raw is not None:
+            dev = max(raw - (n or 0) * rtt, 0.0)
+        out[name] = {"raw_s": raw, "n": n, "device_s": dev}
+    return out
+
+
+def _ordered(names) -> List[str]:
+    known = [s for s in STAGE_ORDER if s in names]
+    return known + sorted(n for n in names if n not in STAGE_ORDER)
+
+
+def render_stage_table(
+    streams: List[Tuple[str, List[dict]]]
+) -> str:
+    """Markdown per-stage table over 1+ labelled streams — the
+    BASELINE.md round-6 differential shape when given two (e.g. a
+    ``--visited sort`` run vs the fpset default); the last column is
+    ``first/last`` ratio when exactly two streams carry timings."""
+    splits = [(lbl, stage_split(evs), result(evs)) for lbl, evs in streams]
+    names = _ordered({n for _l, sp, _r in splits for n in sp})
+    two = len(splits) == 2
+    head = ["Stage"] + [lbl for lbl, _sp, _r in splits]
+    if two:
+        head.append("ratio")
+    lines = [
+        "| " + " | ".join(head) + " |",
+        "|" + "---|" * len(head),
+    ]
+
+    def fmt(sp, name):
+        d = sp.get(name)
+        if d is None:
+            return "—"
+        if d["device_s"] is None:
+            return f"({d['n']} dispatches)" if d["n"] else "—"
+        n = f" ({d['n']})" if d["n"] else ""
+        return f"{d['device_s']:.1f} s{n}"
+
+    for name in names:
+        row = [name] + [fmt(sp, name) for _l, sp, _r in splits]
+        if two:
+            a = splits[0][1].get(name, {}).get("device_s")
+            b = splits[1][1].get(name, {}).get("device_s")
+            row.append(
+                f"{a / b:.1f}x" if a and b else "—"
+            )
+        lines.append("| " + " | ".join(row) + " |")
+    walls = [r.get("wall_s") if r else None for _l, _sp, r in splits]
+    row = ["**total wall**"] + [
+        f"{w:.1f} s" if w is not None else "—" for w in walls
+    ]
+    if two:
+        row.append(
+            f"{walls[0] / walls[1]:.1f}x"
+            if walls[0] and walls[1]
+            else "—"
+        )
+    lines.append("| " + " | ".join(row) + " |")
+    res0 = splits[0][2]
+    if res0 is not None and (res0.get("stats", {}) or {}).get("rtt_s"):
+        lines.append("")
+        lines.append(
+            f"(stage seconds are RTT-corrected: raw barrier time minus "
+            f"dispatches x {res0['stats']['rtt_s']:.4f}s measured "
+            "round-trip)"
+        )
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------- bench keys
+
+
+def bench_keys(events: List[dict]) -> Dict[str, object]:
+    """Every ``fpset_*`` / ``ckpt_*`` / survivability key a BENCH_*
+    artifact carries, straight from the stream — no hand-copying.
+    Primary source: the final ``result`` record; keys that can also be
+    derived from per-event records (frame bytes/stalls, flush deltas)
+    fall back to those when the run died before a result."""
+    res = result(events) or {}
+    stats = res.get("stats", {}) or {}
+    out: Dict[str, object] = {
+        k: v
+        for k, v in stats.items()
+        if k.startswith(("fpset_", "ckpt_"))
+    }
+    for k in (
+        "distinct_states", "diameter", "wall_s", "states_per_sec",
+        "truncated", "stop_reason", "hbm_recovered",
+        "fp_collision_prob",
+    ):
+        if k in res:
+            out[k] = res[k]
+    if "host_wait_s" in stats:
+        out["host_wait_s"] = stats["host_wait_s"]
+    if "stats_fetches" in stats:
+        out["stats_fetches"] = stats["stats_fetches"]
+    # event-derived fallbacks / cross-checks
+    frames = [e for e in events if e.get("event") == "ckpt_frame"]
+    if frames:
+        out.setdefault("ckpt_frames", len(frames))
+        out.setdefault(
+            "ckpt_bytes", sum(int(e.get("bytes", 0)) for e in frames)
+        )
+        out.setdefault(
+            "ckpt_write_s",
+            round(
+                sum(
+                    float(e.get("stall_s", e.get("write_s", 0.0)))
+                    for e in frames
+                ),
+                3,
+            ),
+        )
+    flushes = [e for e in events if e.get("event") == "flush"]
+    if flushes and "fpset_flushes" not in out:
+        fl = sum(int(e.get("flushes", 0)) for e in flushes)
+        rd = sum(int(e.get("probe_rounds", 0)) for e in flushes)
+        out["fpset_flushes"] = fl
+        out["fpset_probe_rounds"] = rd
+        out["fpset_avg_probe_rounds"] = round(rd / max(fl, 1), 2)
+        out["fpset_failures"] = sum(
+            int(e.get("failures", 0)) for e in flushes
+        )
+        out["fpset_valid_lanes"] = sum(
+            int(e.get("valid_lanes", 0)) for e in flushes
+        )
+    recov = [e for e in events if e.get("event") == "hbm_recovery"]
+    if recov:
+        out.setdefault("hbm_recovered", len(recov))
+    hd = header(events)
+    if hd is not None:
+        out["engine"] = hd.get("engine")
+        out["visited_impl"] = hd.get("visited_impl")
+        out["run_id"] = hd.get("run_id")
+    return out

@@ -1,0 +1,343 @@
+"""Telemetry core — versioned JSONL run events, the TLC-style progress
+heartbeat, and the tunnel-RTT probe.
+
+Every engine emits into one append-only JSONL stream (``--telemetry
+out.jsonl`` / ``-telemetry``): a run header, per-level progress
+records, per-flush fpset aggregates, checkpoint-frame writes with their
+write-stall seconds, HBM-recovery and fault-injection events, and the
+final result.  The design rules:
+
+- **Versioned schema.**  Every record carries ``v`` (the schema
+  version), ``event``, ``t`` (monotonic seconds since the stream
+  opened — wall-clock jumps can never reorder records), ``seq`` (a
+  per-stream counter), and ``run_id``.  :data:`EVENTS` is the
+  authoritative required-field table; ``scripts/
+  check_telemetry_schema.py`` validates against it.
+- **Zero hot-path syncs.**  Emission sites are host-side points the
+  engines already pass through (the stats fetch, level boundaries,
+  checkpoint writes).  Telemetry never adds a device round trip — the
+  heartbeat below reports from the *last fetched* stats snapshot, and
+  the zero-sync device counters ride the engines' existing single
+  stats fetch (see ``device_bfs._fpflush_jit``).
+- **Crash-durable lines.**  The stream is opened line-buffered and
+  every record is one ``write()`` of a complete line, so a ``kill -9``
+  (or the ``PTT_FAULT`` kill site) can lose at most the record being
+  written — never corrupt earlier ones.  Fault events are emitted
+  *before* the fault fires for exactly this reason.
+- **Resume linking.**  Checkpoint frames embed the writer's
+  ``run_id`` and ``frame_seq`` (utils/ckpt.py frame meta); a resumed
+  run's header carries them back as ``resume_of`` /
+  ``resume_frame_seq``, so a chain of interrupted runs is one
+  navigable story across stream files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Dict, Optional, Tuple, Union
+
+SCHEMA_VERSION = 1
+
+# Authoritative event table: event name -> required fields beyond the
+# base envelope.  Unknown events are legal (forward compatibility) but
+# must still carry the base envelope.
+BASE_FIELDS: Tuple[str, ...] = ("v", "event", "t", "seq", "run_id")
+EVENTS: Dict[str, Tuple[str, ...]] = {
+    # run lifecycle
+    "run_header": ("engine", "visited_impl", "config_sig"),
+    "result": ("distinct_states", "diameter", "wall_s", "truncated"),
+    # progress
+    "level": (
+        "level", "new_states", "distinct_states", "frontier", "wall_s",
+        "states_per_sec",
+    ),
+    "progress": ("distinct_states", "states_per_sec"),
+    # dedup / fpset (deltas since the previous flush record)
+    "flush": ("flushes", "probe_rounds", "failures", "valid_lanes"),
+    "fpset_insert": ("inserts", "probe_rounds", "n"),
+    # survivability
+    "ckpt_frame": ("frame_seq", "bytes", "write_s", "distinct_states"),
+    "hbm_recovery": ("recovery_n",),
+    "fault": ("kind", "site", "count"),
+    # legacy differential stage timings (PTT_STAGE_TIMING runs)
+    "stage_timing": ("stages",),
+}
+
+
+def new_run_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+class Telemetry:
+    """One JSONL event stream (append-only, line-buffered, thread-safe).
+
+    ``t`` is monotonic seconds since this object was created; the run
+    header records the wall-clock anchor (``wall_unix``) once so humans
+    can place the run in time without wall-clock jumps ever reordering
+    records.
+    """
+
+    enabled = True
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id or new_run_id()
+        self._t0 = time.monotonic()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {
+            "v": SCHEMA_VERSION,
+            "event": event,
+            "t": 0.0,
+            "run_id": self.run_id,
+        }
+        rec.update(fields)
+        with self._lock:
+            # timestamp UNDER the lock: the heartbeat thread and the
+            # engine thread share this stream, and a t captured before
+            # a lost lock race would violate the per-run monotonic-t
+            # contract the schema validator enforces
+            rec["t"] = round(time.monotonic() - self._t0, 6)
+            rec["seq"] = self._seq
+            self._seq += 1
+            if self._f.closed:
+                return
+            # one write of one complete line: crash-durable up to the
+            # record being written (see module docstring)
+            self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class NullTelemetry:
+    """No-op stand-in so engines never branch on "telemetry enabled"."""
+
+    enabled = False
+    path = None
+    run_id = None
+
+    def emit(self, event: str, **fields) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL = NullTelemetry()
+
+
+def as_telemetry(
+    t: Union[None, str, Telemetry, NullTelemetry],
+    run_id: Optional[str] = None,
+) -> Union[Telemetry, NullTelemetry]:
+    """None -> the shared null sink; a path -> a fresh stream bound to
+    ``run_id``; an existing Telemetry passes through unchanged (the
+    caller keeps ownership — see :func:`owns_stream`)."""
+    if t is None:
+        return NULL
+    if isinstance(t, (Telemetry, NullTelemetry)):
+        return t
+    return Telemetry(t, run_id=run_id)
+
+
+def owns_stream(arg) -> bool:
+    """True when :func:`as_telemetry` would CREATE the stream for this
+    argument — i.e. the engine opened it and must close it.  A caller
+    passing an existing Telemetry instance keeps ownership (it may be
+    collecting several runs into one stream), so engines must not
+    close it."""
+    return not isinstance(arg, (Telemetry, NullTelemetry))
+
+
+# ------------------------------------------------------------ heartbeat
+
+
+class Heartbeat:
+    """TLC-style periodic progress lines from the last fetched stats
+    snapshot — ZERO device syncs added.
+
+    The engine mutates ``snap`` (a plain dict: ``distinct_states``,
+    ``level``, ``frontier``, optionally ``occupancy``) at points it
+    already syncs (the stats fetch / level boundary); this thread wakes
+    every ``every_s`` seconds, reads whatever snapshot is there, and
+    reports — it never touches the device.  ``capacity`` (max_states)
+    enables the ETA-to-capacity estimate from the recent rate.
+
+    Shutdown contract (SIGTERM/preemption): the thread is a daemon and
+    the engine stops it in a ``finally`` around the run loop, so a
+    preempted run ends with a joined thread and a complete final line —
+    never a heartbeat printing into a dead run (and ``os._exit`` style
+    deaths can't be held up by it either).
+    """
+
+    def __init__(
+        self,
+        every_s: float,
+        snap: dict,
+        telemetry: Union[Telemetry, NullTelemetry] = NULL,
+        capacity: Optional[int] = None,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        if every_s <= 0:
+            raise ValueError(f"heartbeat interval must be > 0: {every_s}")
+        self.every_s = every_s
+        self.snap = snap
+        self.tel = telemetry
+        self.capacity = capacity
+        self._log = log
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.beats = 0
+
+    def _emit_line(self, msg: str) -> None:
+        if self._log is not None:
+            self._log(msg)
+        else:
+            import sys
+
+            print(msg, file=sys.stderr, flush=True)
+
+    def _beat(self, t_start: float, prev: Tuple[float, int]):
+        now = time.monotonic()
+        nv = int(self.snap.get("distinct_states", 0))
+        level = self.snap.get("level")
+        frontier = self.snap.get("frontier")
+        occ = self.snap.get("occupancy")
+        gen = self.snap.get("generated")
+        elapsed = max(now - t_start, 1e-9)
+        avg_sps = nv / elapsed
+        dt = max(now - prev[0], 1e-9)
+        recent_sps = max(nv - prev[1], 0) / dt
+        eta_s = None
+        if self.capacity and recent_sps > 0:
+            eta_s = (self.capacity - nv) / recent_sps
+        msg = (
+            f"Progress({level if level is not None else '?'}) at "
+            f"{elapsed:.0f}s: "
+            + (f"{int(gen):,} states generated, " if gen is not None else "")
+            + f"{nv:,} distinct states"
+            + (f", frontier {int(frontier):,}" if frontier is not None else "")
+            + f", {recent_sps:,.0f} st/s (avg {avg_sps:,.0f})"
+            + (f", fpset occupancy {occ:.1%}" if occ is not None else "")
+            + (
+                f", ~{eta_s:.0f}s to the state cap"
+                if eta_s is not None and eta_s >= 0
+                else ""
+            )
+        )
+        self._emit_line(msg)
+        self.tel.emit(
+            "progress",
+            distinct_states=nv,
+            states_per_sec=round(recent_sps, 1),
+            avg_states_per_sec=round(avg_sps, 1),
+            **({"generated": int(gen)} if gen is not None else {}),
+            **({"level": level} if level is not None else {}),
+            **(
+                {"frontier": int(frontier)}
+                if frontier is not None
+                else {}
+            ),
+            **({"occupancy": occ} if occ is not None else {}),
+            **({"eta_capacity_s": round(eta_s, 1)} if eta_s else {}),
+        )
+        self.beats += 1
+        return (now, nv)
+
+    def _loop(self):
+        t_start = time.monotonic()
+        prev = (t_start, int(self.snap.get("distinct_states", 0)))
+        while not self._stop.wait(self.every_s):
+            prev = self._beat(t_start, prev)
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ptt-heartbeat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.every_s + 1.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def parse_level_window(spec: str) -> Tuple[int, int]:
+    """Parse an xprof level window ``"LO:HI"`` -> (lo, hi); raises
+    ValueError with a usable message on malformed or inverted input
+    (shared by the CLI and bench front-ends)."""
+    try:
+        lo_s, hi_s = spec.split(":", 1)
+        lo, hi = int(lo_s), int(hi_s)
+    except ValueError:
+        raise ValueError(
+            f"bad level window {spec!r} (want LO:HI, e.g. 7:7)"
+        ) from None
+    if lo > hi:
+        raise ValueError(
+            f"bad level window {spec!r} (LO must be <= HI)"
+        )
+    return lo, hi
+
+
+# ------------------------------------------------------------ RTT probe
+
+
+def measure_rtt(n: int = 3) -> float:
+    """One-time host<->device round-trip probe (seconds).
+
+    Fetches a freshly computed device scalar ``n`` times and returns
+    the MINIMUM wall time — the first fetch may pay a (cached
+    thereafter) compile, and min is the honest latency floor the
+    ``_stage_mark`` barrier pays per drain.  ~130 ms on the tunnel
+    TPU backend, ~0 on local CPU.  Called once at warmup; the report
+    layer subtracts ``stage_<name>_n x rtt`` from legacy stage
+    timings (docs/observability.md).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    best = float("inf")
+    y = jnp.int32(0)
+    for _ in range(max(n, 1)):
+        y = y + jnp.int32(1)  # a fresh value: the fetch cannot be cached
+        t0 = time.perf_counter()
+        np.asarray(y)
+        best = min(best, time.perf_counter() - t0)
+    return best

@@ -361,11 +361,24 @@ class FPSet:
     probe/occupancy/failure metrics.  The device engines inline the
     functional core above in their own jitted programs instead."""
 
-    def __init__(self, ncols: int, cap: int = 1 << 10):
+    def __init__(self, ncols: int, cap: int = 1 << 10, telemetry=None):
+        from pulsar_tlaplus_tpu.obs import telemetry as obs
+
         self.cols = empty_cols(cap, ncols)
         self.ncols = ncols
         self.n = 0
         self.stats = {"inserts": 0, "probe_rounds": 0, "failures": 0}
+        # optional JSONL stream (obs.telemetry): one ``fpset_insert``
+        # record per batched insert — host-loop users get the same
+        # per-flush visibility the device engines emit
+        self.tel = obs.as_telemetry(telemetry)
+        self._tel_owned = obs.owns_stream(telemetry)
+
+    def close(self) -> None:
+        """Close a telemetry stream this FPSet opened (a caller-passed
+        Telemetry instance stays the caller's to close)."""
+        if self._tel_owned:
+            self.tel.close()
 
     @property
     def cap(self) -> int:
@@ -410,6 +423,14 @@ class FPSet:
         self.stats["inserts"] += 1
         self.stats["probe_rounds"] += int(rounds)
         self.stats["failures"] += nf
+        self.tel.emit(
+            "fpset_insert",
+            inserts=self.stats["inserts"],
+            probe_rounds=int(rounds),
+            failures=nf,
+            n=self.n,
+            occupancy=round(self.occupancy, 4),
+        )
         if nf:
             raise RuntimeError(
                 f"fpset probe overflow ({nf} lanes unresolved) — "

@@ -31,6 +31,7 @@ Design rules every engine follows:
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import threading
@@ -56,22 +57,47 @@ def config_sig(**fields) -> str:
 def save_frame(
     path: str, sig: str, arrays: Dict[str, np.ndarray],
     wall_s: float = 0.0,
-) -> int:
-    """Write one checkpoint frame atomically; returns its size in
-    bytes.  ``sig`` is the writer's config signature (verified by
-    :func:`load_frame`); ``wall_s`` the cumulative run wall time so a
-    resumed run's states/sec stays meaningful end to end."""
+    meta: Optional[Dict[str, object]] = None,
+) -> Tuple[int, float]:
+    """Write one checkpoint frame atomically; returns ``(nbytes,
+    write_s)`` — size plus the frame-write stall time the caller was
+    blocked here (the ``ckpt_write_s`` telemetry counter: compression +
+    fsync-adjacent filesystem time, NOT the D2H gather, which engines
+    time on their side).  ``sig`` is the writer's config signature
+    (verified by :func:`load_frame`); ``wall_s`` the cumulative run
+    wall time so a resumed run's states/sec stays meaningful end to
+    end.  ``meta`` is an optional small JSON-able dict (writer run_id,
+    frame_seq, level) stored under ``__meta__`` — read back with
+    :func:`frame_meta`; v2 frames without it still load."""
+    t0 = time.perf_counter()
     tmp = path + ".tmp.npz"
+    extra = {}
+    if meta:
+        extra["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )
     np.savez_compressed(
         tmp,
         __format__=np.int64(FORMAT_VERSION),
         sig=np.frombuffer(sig.encode(), dtype=np.uint8),
         wall_s=np.float64(wall_s),
+        **extra,
         **arrays,
     )
     nbytes = os.path.getsize(tmp)
     os.replace(tmp, path)  # atomic vs crashes and concurrent readers
-    return nbytes
+    return nbytes, time.perf_counter() - t0
+
+
+def frame_meta(d) -> Dict[str, object]:
+    """Writer metadata of a loaded frame (``{}`` for frames that
+    predate the field or carry none)."""
+    if "__meta__" not in d:
+        return {}
+    try:
+        return json.loads(d["__meta__"].tobytes().decode())
+    except (ValueError, AttributeError):
+        return {}
 
 
 def load_frame(path: str, sig: str, what: str = "configuration"):

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Validate telemetry JSONL streams and BENCH_*.json artifacts against
+the versioned schemas — wired as a tier-1 test so a bench-artifact or
+stream regression fails fast instead of surfacing as a hand-transcribed
+table that doesn't add up.
+
+    python scripts/check_telemetry_schema.py run.jsonl BENCH_r06.json
+    python scripts/check_telemetry_schema.py --all-bench   # repo BENCH_*.json
+
+File kind is sniffed by extension: ``.jsonl`` = event stream, ``.json``
+= bench artifact (the driver wrapper ``{"parsed": {...}}`` and the raw
+bench line both work).
+
+Stream rules (schema v1, ``obs/telemetry.py`` EVENTS is authoritative):
+every line parses as an object; carries ``v``/``event``/``t``/
+``run_id``; ``v`` <= the supported version; ``t`` is monotonically
+non-decreasing per run_id; known event types carry their required
+fields.  Bench rules: ``bench_schema`` >= 2 requires the headline keys,
+>= 3 additionally the telemetry/survivability key set (``fpset_*``,
+``ckpt_*``, ``stop_reason``...).
+
+Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from pulsar_tlaplus_tpu.obs.telemetry import (  # noqa: E402
+    BASE_FIELDS,
+    EVENTS,
+    SCHEMA_VERSION,
+)
+
+# bench-artifact key requirements by bench_schema version (additive)
+BENCH_KEYS_V2 = (
+    "metric", "value", "unit", "vs_baseline", "vs_baseline_definition",
+    "distinct_states", "levels", "compile_warmup_s",
+)
+BENCH_KEYS_V3 = BENCH_KEYS_V2 + (
+    "stop_reason", "truncated", "hbm_recovered",
+    "ckpt_frames", "ckpt_bytes", "ckpt_write_s",
+    "fpset_flushes", "fpset_probe_rounds", "fpset_avg_probe_rounds",
+    "fpset_failures", "fpset_occupancy",
+    "fpset_valid_lanes", "fpset_max_probe_rounds",
+    "visited_impl", "max_states", "stats_fetches",
+)
+
+
+def validate_stream(path: str) -> List[str]:
+    """All schema violations in one stream (empty list = clean)."""
+    errors: List[str] = []
+    last_t: dict = {}
+    n = 0
+    try:
+        f = open(path)
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    with f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{i}: unparseable JSON ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"{path}:{i}: not a JSON object")
+                continue
+            missing = [k for k in BASE_FIELDS if k not in rec]
+            if missing:
+                errors.append(
+                    f"{path}:{i}: missing base fields {missing}"
+                )
+                continue
+            if not isinstance(rec["v"], int) or rec["v"] < 1:
+                errors.append(f"{path}:{i}: bad schema version {rec['v']!r}")
+            elif rec["v"] > SCHEMA_VERSION:
+                errors.append(
+                    f"{path}:{i}: schema v{rec['v']} newer than "
+                    f"supported v{SCHEMA_VERSION}"
+                )
+            if not isinstance(rec["t"], (int, float)):
+                errors.append(f"{path}:{i}: non-numeric t {rec['t']!r}")
+            else:
+                rid = rec["run_id"]
+                if rec["t"] < last_t.get(rid, float("-inf")):
+                    errors.append(
+                        f"{path}:{i}: t went backwards for run "
+                        f"{rid} ({rec['t']} < {last_t[rid]})"
+                    )
+                last_t[rid] = rec["t"]
+            req = EVENTS.get(rec["event"])
+            if req:
+                miss = [k for k in req if k not in rec]
+                if miss:
+                    errors.append(
+                        f"{path}:{i}: {rec['event']} missing {miss}"
+                    )
+    if n == 0:
+        errors.append(f"{path}: empty stream")
+    return errors
+
+
+def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
+    """Violations in one bench artifact (file path or parsed dict).
+    Driver wrappers (``{"parsed": {...}}``) unwrap automatically."""
+    if isinstance(path_or_dict, dict):
+        d = path_or_dict
+        label = path or "<dict>"
+    else:
+        label = path_or_dict
+        try:
+            with open(path_or_dict) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"{path_or_dict}: unreadable ({e})"]
+    if "parsed" in d and isinstance(d["parsed"], dict):
+        d = d["parsed"]
+    errors: List[str] = []
+    schema = d.get("bench_schema")
+    if schema is None:
+        # pre-schema artifacts (r1-r3): only the headline keys existed
+        for k in ("metric", "value", "unit"):
+            if k not in d:
+                errors.append(f"{label}: missing {k}")
+        return errors
+    if not isinstance(schema, int) or schema < 2:
+        errors.append(f"{label}: bad bench_schema {schema!r}")
+        return errors
+    required = BENCH_KEYS_V3 if schema >= 3 else BENCH_KEYS_V2
+    for k in required:
+        if k not in d:
+            errors.append(
+                f"{label}: bench_schema {schema} missing key {k!r}"
+            )
+    if not isinstance(d.get("value"), (int, float)):
+        errors.append(f"{label}: non-numeric value {d.get('value')!r}")
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate telemetry streams (.jsonl) and bench "
+        "artifacts (.json) against the versioned schemas"
+    )
+    ap.add_argument("files", nargs="*", help=".jsonl streams / .json artifacts")
+    ap.add_argument(
+        "--all-bench", action="store_true",
+        help="also validate every BENCH_*.json in the repo root",
+    )
+    args = ap.parse_args(argv)
+    files = list(args.files)
+    if args.all_bench:
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        files += sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    if not files:
+        ap.error("nothing to validate (pass files or --all-bench)")
+    errors: List[str] = []
+    for p in files:
+        if p.endswith(".jsonl"):
+            errors += validate_stream(p)
+        else:
+            errors += validate_bench_artifact(p)
+    for e in errors:
+        print(e, file=sys.stderr)
+    print(
+        f"{len(files)} file(s), {len(errors)} violation(s)",
+        file=sys.stderr,
+    )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Turn a telemetry JSONL stream into the BASELINE.md per-stage table
+and the BENCH_* artifact keys — no hand-copied numbers.
+
+    # per-stage table + bench keys of one run:
+    python scripts/telemetry_report.py run.jsonl
+
+    # the round-6 differential shape (fpset vs --visited sort):
+    python scripts/telemetry_report.py fpset.jsonl --compare sort.jsonl \
+        --labels fpset sort-merge
+
+    # just the BENCH keys as JSON (pipe into the artifact):
+    python scripts/telemetry_report.py run.jsonl --bench-keys
+
+Stage seconds exist only for ``PTT_STAGE_TIMING=1`` runs (the legacy
+serializing barrier); they are RTT-corrected here — ``stage_<name>_n x
+rtt_s`` (the warmup round-trip probe) is subtracted, closing the
+documented-but-never-applied ~130 ms/drain overstatement.  Zero-sync
+runs still report dispatch counts, flush metrics, and all bench keys.
+
+No third-party deps — runs anywhere the repo does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from pulsar_tlaplus_tpu.obs import report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="telemetry JSONL -> per-stage table + BENCH keys"
+    )
+    ap.add_argument("stream", help="telemetry JSONL file")
+    ap.add_argument(
+        "--compare", default=None, metavar="OTHER",
+        help="second stream: renders the two-column differential "
+        "table (BASELINE.md round-6 shape) with a ratio column",
+    )
+    ap.add_argument(
+        "--labels", nargs="*", default=None,
+        help="column labels (default: file basenames)",
+    )
+    ap.add_argument(
+        "--bench-keys", action="store_true",
+        help="print ONLY the fpset_*/ckpt_* BENCH keys as one JSON "
+        "object",
+    )
+    args = ap.parse_args(argv)
+
+    paths = [args.stream] + ([args.compare] if args.compare else [])
+    labels = args.labels or [
+        os.path.splitext(os.path.basename(p))[0] for p in paths
+    ]
+    if len(labels) != len(paths):
+        ap.error("--labels must match the number of streams")
+    streams = []
+    for lbl, p in zip(labels, paths):
+        evs, errs = report.load_events(p)
+        for e in errs:
+            print(f"{p}: WARNING: {e}", file=sys.stderr)
+        if not evs:
+            print(f"{p}: no telemetry events", file=sys.stderr)
+            return 2
+        streams.append((lbl, evs))
+
+    if args.bench_keys:
+        print(json.dumps(report.bench_keys(streams[0][1]), indent=2))
+        return 0
+
+    hd = report.header(streams[0][1])
+    if hd is not None:
+        print(
+            f"run {hd.get('run_id')} — {hd.get('engine')} "
+            f"({hd.get('visited_impl')}) on {hd.get('device')}\n"
+        )
+    print(report.render_stage_table(streams))
+    print()
+    print("BENCH keys:")
+    print(json.dumps(report.bench_keys(streams[0][1]), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,8 @@ def main():
     ap.add_argument("--invariant", default=None)
     ap.add_argument("--every", type=int, default=2)
     ap.add_argument("--max-states", type=int, default=200_000_000)
+    ap.add_argument("--telemetry", default=None)
+    ap.add_argument("--progress", type=float, default=None)
     args = ap.parse_args()
 
     import jax
@@ -45,6 +47,8 @@ def main():
             frontier_cap=1 << 15, max_states=args.max_states,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.every,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
         )
     else:
         from pulsar_tlaplus_tpu.engine.sharded_device import (
@@ -56,6 +60,8 @@ def main():
             visited_cap=1 << 13, max_states=args.max_states,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.every,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
         )
     r = ck.run(resume=args.resume)
     print(
