@@ -414,9 +414,10 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # vs_baseline redefined in r4 to the 8x-extrapolated
                 # native baseline (schema 2); schema 3 adds the
                 # telemetry/survivability key set (fpset_*, ckpt_*,
-                # stop_reason...) validated by
-                # scripts/check_telemetry_schema.py
-                "bench_schema": 3,
+                # stop_reason...); schema 4 adds ckpt_retries (the
+                # frame writer's transient-failure retry breadcrumb)
+                # — validated by scripts/check_telemetry_schema.py
+                "bench_schema": 4,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2
@@ -455,6 +456,10 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # frame-write stall seconds (BENCH_r07 ask): host time
                 # the run loop spent blocked gathering + writing frames
                 "ckpt_write_s": ck.last_stats.get("ckpt_write_s", 0.0),
+                # transient frame-write failures absorbed by the
+                # retry/backoff path (nonzero = the disk hiccuped and
+                # the run survived it; docs/robustness.md)
+                "ckpt_retries": ck.last_stats.get("ckpt_retries", 0),
                 "checkpoint": args.checkpoint,
                 "telemetry": args.telemetry,
                 "stats_fetches": ck.last_stats.get("stats_fetches"),

@@ -231,6 +231,39 @@ def _check_interp(args, module, spec_path, tlc_cfg, invariants):
     return _report(r, None, time.time() - t0)
 
 
+def _report_liveness(prop, args, lres) -> int:
+    """Liveness verdict report + exit code (0 holds, 1 violated, 3
+    preempted/truncated — an interrupted run carries NO verdict)."""
+    if lres.truncated:
+        if lres.stop_reason == "preempted":
+            if args.checkpoint and os.path.exists(args.checkpoint):
+                print(
+                    f"Temporal property {prop}: run preempted "
+                    "(SIGTERM/SIGINT) — no verdict.  A resumable "
+                    "frame is on disk; continue with -recover."
+                )
+            else:
+                print(
+                    f"Temporal property {prop}: run preempted "
+                    "(SIGTERM/SIGINT) before any frame could be "
+                    "written — no verdict, and the run is NOT "
+                    "resumable."
+                )
+        else:
+            print(
+                f"Temporal property {prop}: run truncated "
+                f"({lres.stop_reason or 'unknown'}) — no verdict."
+            )
+        return 3
+    verdict = "satisfied" if lres.holds else "VIOLATED"
+    print(
+        f"Temporal property {prop} (fairness={args.fairness}): "
+        f"{verdict} — {lres.reason}"
+    )
+    print(f"{lres.distinct_states} distinct states examined.")
+    return 0 if lres.holds else 1
+
+
 def _check_properties(args, model, properties, rc):
     """Check cfg PROPERTIES after a clean safety pass (TLC checks
     temporal properties from the same run); shared by the registry and
@@ -258,6 +291,14 @@ def _check_properties(args, model, properties, rc):
                     fairness=args.fairness,
                     frontier_chunk=args.chunk,
                     max_states=args.maxstates,
+                    # the safety phase completed cleanly, so its frame
+                    # at this path is obsolete — the liveness phase
+                    # takes over the checkpoint file (TLC-style: one
+                    # states location per invocation)
+                    checkpoint_path=args.checkpoint,
+                    telemetry=args.telemetry,
+                    heartbeat_s=args.progress,
+                    progress=True,
                 )
                 lres = lck.run()
             else:
@@ -266,6 +307,11 @@ def _check_properties(args, model, properties, rc):
                 lres = lck.run_goal(prop)
         except (ValueError, RuntimeError) as e:
             sys.exit(f"tpu-tlc: {e}")
+        if lres.truncated:
+            # preemption/truncation carries NO verdict; stop checking
+            # further properties (the operator asked the run to end).
+            # _report_liveness prints the resume guidance (-recover)
+            return _report_liveness(prop, args, lres)
         verdict = "satisfied" if lres.holds else "VIOLATED"
         print(
             f"Temporal property {prop} (fairness={args.fairness}): "
@@ -295,15 +341,13 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             "(use -profile DIR to trace the whole check)",
             file=sys.stderr,
         )
-    if (args.telemetry or args.progress) and (
-        args.liveness_property or args.simulate
-    ):
-        # same promise: flags that do nothing must say so, not silently
-        # drop (the BFS engines are the only telemetry emitters today)
+    if (args.telemetry or args.progress) and args.simulate:
+        # flags that do nothing must say so, not silently drop (the
+        # BFS + liveness engines are the telemetry emitters today)
         print(
             "tpu-tlc: note: -telemetry/-progress are not wired into "
-            "the liveness/simulation engines yet; no stream or "
-            "heartbeat will be produced for this run",
+            "the simulation engine yet; no stream or heartbeat will "
+            "be produced for this run",
             file=sys.stderr,
         )
     if args.liveness_property:
@@ -316,17 +360,20 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
                 fairness=args.fairness,
                 frontier_chunk=args.chunk,
                 max_states=args.maxstates,
+                checkpoint_path=args.checkpoint,
+                telemetry=args.telemetry,
+                heartbeat_s=args.progress,
+                progress=True,
             )
-            lres = lck.run()
+            lres = lck.run(resume=args.recover)
+        except FileNotFoundError:
+            sys.exit(
+                "tpu-tlc: -recover needs an existing -checkpoint file "
+                f"(got: {args.checkpoint})"
+            )
         except (ValueError, RuntimeError) as e:
             sys.exit(f"tpu-tlc: {e}")
-        verdict = "satisfied" if lres.holds else "VIOLATED"
-        print(
-            f"Temporal property {args.liveness_property} "
-            f"(fairness={args.fairness}): {verdict} — {lres.reason}"
-        )
-        print(f"{lres.distinct_states} distinct states examined.")
-        return 0 if lres.holds else 1
+        return _report_liveness(args.liveness_property, args, lres)
     if args.simulate:
         from pulsar_tlaplus_tpu.engine.simulate import Simulator
 

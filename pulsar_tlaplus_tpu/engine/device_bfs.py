@@ -58,7 +58,7 @@ from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.obs import telemetry as obs
-from pulsar_tlaplus_tpu.utils import ckpt, device, faults
+from pulsar_tlaplus_tpu.utils import ckpt, device, faults, recovery
 from pulsar_tlaplus_tpu.utils.aot_cache import ajit
 from pulsar_tlaplus_tpu.ops import dedup, fpset
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
@@ -72,20 +72,10 @@ BIG = jnp.int32(2**31 - 1)
 # after validity masking (the duplicate-rate denominator the host
 # cannot know without a sync); max_probe_rounds is the worst flush's
 # probe depth (a running max, not a sum).  Pre-r8 checkpoint frames
-# carry the 3-wide prefix and restore zero-padded.
-FPM_N = 5
+# carry the 3-wide prefix and restore zero-padded.  Shared with the
+# sharded engine via ops/fpset.py (r9).
+FPM_N = fpset.FPM_N
 
-
-class _HbmExhausted(Exception):
-    """Internal control flow: a RESOURCE_EXHAUSTED surfaced while a
-    valid checkpoint frame exists — the run loop rebuilds device state
-    from that frame at degraded capacity instead of truncating."""
-
-    def __init__(self, nv: int, level_sizes, msg: str):
-        super().__init__(msg)
-        self.nv = nv
-        self.level_sizes = level_sizes
-        self.msg = msg
 # payload word: low 31 bits = accumulator slot index, bit 31 = the
 # candidate tag (visited entries carry payload 0, so the payload doubles
 # as the visited-vs-candidate sort tie-breaker)
@@ -287,8 +277,10 @@ class DeviceChecker:
         self.time_budget_s = time_budget_s
         self.progress = progress
         self.metrics_path = metrics_path
-        self.group = group
-        self._group0 = group  # pre-degradation group-ahead (see run())
+        # armed/recovered/degraded bookkeeping shared with the sharded
+        # engine (utils/recovery.py); ``group`` (the dispatch
+        # group-ahead) lives there because recovery halves it
+        self.rec = recovery.RecoveryState(checkpoint_path, group)
         if seed_cap is not None:
             # sorted-column capacity of the host-seed merge path; a
             # bench-scale warm start (VERDICT r3: the first ~10 s of
@@ -298,20 +290,13 @@ class DeviceChecker:
             self.SEED_VCAP = self._round_cap(seed_cap)
         # run-survivability state (round 7): level-boundary checkpoint
         # frames shared with the sharded engine via utils/ckpt.py,
-        # HBM-exhaustion recovery, and preemption-safe shutdown
+        # HBM-exhaustion recovery (utils/recovery.py), and
+        # preemption-safe shutdown
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
-        self._hbm_recovered = 0
         self._ckpt_frames = 0
         self._ckpt_bytes = 0
-        # True whenever the on-disk frame is valid AND no recovery has
-        # consumed it since: a second exhaustion without a fresh frame
-        # in between means recovery is not making progress — truncate
-        self._recover_armed = False
-        # set by a recovery: growth headroom drops to one accumulator
-        # (degraded capacity so the retry fits where the full-headroom
-        # run did not)
-        self._headroom_frozen = False
+        self._ckpt_retries = 0
         self._watcher = None
         self._flush_seq = 0
         self._jits: Dict[tuple, object] = {}
@@ -346,6 +331,20 @@ class DeviceChecker:
         ) not in ("", "0")
 
     # -------------------------------------------------------------- util
+
+    # recovery bookkeeping delegates (utils/recovery.py is the one
+    # source of truth; these keep the engine's established names)
+    @property
+    def group(self) -> int:
+        return self.rec.group
+
+    @property
+    def _hbm_recovered(self) -> int:
+        return self.rec.hbm_recovered
+
+    @property
+    def _headroom_frozen(self) -> bool:
+        return self.rec.headroom_frozen
 
     def _round_cap(self, c: int) -> int:
         n = 1 << 10
@@ -1398,24 +1397,36 @@ class DeviceChecker:
         self._flush_seq = 0
         # per-run recovery/telemetry state: a fresh run() must not
         # inherit a previous run's degraded capacity or frame counts
-        self._hbm_recovered = 0
+        self.rec.reset()
         self._ckpt_frames = 0
         self._ckpt_bytes = 0
         self._ckpt_write_s = 0.0
-        self._recover_armed = False
-        self._headroom_frozen = False
+        self._ckpt_retries = 0
         self._fetch_n = 0
         self._fpm_prev = np.zeros((FPM_N,), np.int64)
         self._resume_meta = {}
         self._xprof_on = False
         self._xprof_done = False
-        self.group = self._group0
+        # a crash mid-frame-write can leave a dead multi-GB tmp behind
+        # (the atomic replace never published it); clear it up front
+        ckpt.cleanup_stale_tmp(self.checkpoint_path)
         # telemetry stream: fresh run_id per run() (frames embed it, so
         # a resumed run can link back to the writer of its frame)
         rid = obs.new_run_id()
         self.tel = obs.as_telemetry(self._telemetry_arg, run_id=rid)
         self._run_id = self.tel.run_id or rid
         self._snap = {"distinct_states": 0}
+        # crash breadcrumbs: fault events flush BEFORE the fault fires
+        # (kill sites leave no other trace).  Installed FIRST — before
+        # the heartbeat, the RTT probe, or any warmup-adjacent dispatch
+        # — so even a level-1/flush-1 drill leaves its breadcrumb
+        # (emitting to the null sink is a no-op, so this is
+        # unconditional)
+        faults.set_observer(
+            lambda kind, site, count: self.tel.emit(
+                "fault", kind=kind, site=site, count=count
+            )
+        )
         # the legacy stage-timing mode needs the RTT baseline even when
         # the caller skipped warmup() (report subtracts n x rtt)
         if self._stage_timing and "rtt_s" not in self.last_stats:
@@ -1425,14 +1436,6 @@ class DeviceChecker:
             hb = obs.Heartbeat(
                 self.heartbeat_s, self._snap, telemetry=self.tel,
                 capacity=self.SCAP,
-            )
-        if self.tel.enabled:
-            # crash breadcrumbs: fault events flush BEFORE the fault
-            # fires (kill sites leave no other trace)
-            faults.set_observer(
-                lambda kind, site, count: self.tel.emit(
-                    "fault", kind=kind, site=site, count=count
-                )
             )
         # preemption-safe shutdown (TPU-VM contract): SIGTERM/SIGINT
         # request a checkpoint at the next level boundary; only armed
@@ -1539,7 +1542,7 @@ class DeviceChecker:
                 bufs, st, rb, level_sizes, level_base, nf, saved_wall,
             ) = self._restore_frame()
             t0 = time.time() - saved_wall
-            self._recover_armed = True  # the on-disk frame is valid
+            self.rec.arm()  # the on-disk frame is valid
             self._emit_header(resume=True)
             stats = self._fetch(st)
             return self._run_recoverable(
@@ -1547,6 +1550,13 @@ class DeviceChecker:
             )
         m = self.model
         self._emit_header(resume=False)
+        # level-1 fault site: the run loop's poll counts start at 2
+        # (the first level expanded AFTER init), so without this a
+        # kill@level:1 drill would never fire — and the observer above
+        # is already installed, so the breadcrumb lands first
+        kinds = faults.poll("level", 1)
+        if "oom" in kinds:
+            raise faults.oom_error("level", 1)
         n_inv = len(self.invariant_names)
         K = self.K
         bufs = {
@@ -1778,20 +1788,17 @@ class DeviceChecker:
                     t0, bufs, st, rb, level_sizes, level_base, nf,
                     stats,
                 )
-            except _HbmExhausted as hx:
+            except recovery.HbmExhausted as hx:
                 last = (hx.nv, hx.level_sizes, hx.msg)
                 # the rebuild happens OUTSIDE this except block: the
                 # exception's traceback pins _level_loop's frame
                 # locals (accumulator tuples, expand windows) and the
                 # chained original XLA error — restoring under it
                 # would re-OOM exactly when memory is tightest
-            self._hbm_recovered += 1
-            self._recover_armed = False
             # degraded capacity for the retry: halve the dispatch
             # group-ahead (fewer in-flight flushes = smaller
             # worst-case transients) and freeze growth headroom
-            self.group = max(1, self.group // 2)
-            self._headroom_frozen = True
+            self.rec.degrade()
             self.tel.emit(
                 "hbm_recovery",
                 recovery_n=self._hbm_recovered,
@@ -1815,7 +1822,7 @@ class DeviceChecker:
                 ) = self._restore_frame()
                 stats = self._fetch(st)
             except Exception as e:  # noqa: BLE001
-                if "RESOURCE_EXHAUSTED" not in str(e):
+                if not recovery.is_resource_exhausted(e):
                     raise
                 # recovery itself exhausted memory: report what
                 # the interrupted run had verified, honestly
@@ -1984,7 +1991,7 @@ class DeviceChecker:
                         # fits where the full-headroom run did not
                         head = (
                             self.ACAP
-                            if self._headroom_frozen
+                            if self.rec.headroom_frozen
                             else (self.group + 1) * self.ACAP
                         )
                         if nv + self.ACAP > self.VCAP:
@@ -2016,10 +2023,12 @@ class DeviceChecker:
                     group_f0 = f_off + self.G
                     w = 0
             except Exception as e:  # noqa: BLE001
-                if "RESOURCE_EXHAUSTED" not in str(e):
+                if not recovery.is_resource_exhausted(e):
                     raise
                 if self._can_recover():
-                    raise _HbmExhausted(nv, list(level_sizes), repr(e))
+                    raise recovery.HbmExhausted(
+                        nv, list(level_sizes), repr(e)
+                    )
                 # HBM exhausted with no frame to rebuild from: report
                 # what was checked so far (truncated).  Only the small
                 # stats scalars are read from here on; the big buffers
@@ -2030,10 +2039,12 @@ class DeviceChecker:
             try:
                 stats = self._fetch(st)
             except Exception as e:  # noqa: BLE001
-                if "RESOURCE_EXHAUSTED" not in str(e):
+                if not recovery.is_resource_exhausted(e):
                     raise
                 if self._can_recover():
-                    raise _HbmExhausted(nv, list(level_sizes), repr(e))
+                    raise recovery.HbmExhausted(
+                        nv, list(level_sizes), repr(e)
+                    )
                 self._bufs_poisoned = True
                 stop = True  # keep the last successfully fetched stats
             nv = int(stats[0])
@@ -2117,11 +2128,7 @@ class DeviceChecker:
         )
 
     def _can_recover(self) -> bool:
-        return (
-            self._recover_armed
-            and self.checkpoint_path is not None
-            and os.path.exists(self.checkpoint_path)
-        )
+        return self.rec.can_recover()
 
     def _save_frame(
         self, bufs, st, rb, level_sizes, level_base, nf, nv, t0
@@ -2179,7 +2186,7 @@ class DeviceChecker:
                 # sorted columns: the first nv entries are the real
                 # keys (SENTINEL pad sorts behind every real key)
                 arrays[f"vk{i}"] = np.asarray(col[:nv])
-        nbytes, write_s = ckpt.save_frame(
+        nbytes, write_s, retries = ckpt.save_frame(
             self.checkpoint_path, self._config_sig(), arrays,
             wall_s=time.time() - t0,
             meta={
@@ -2195,11 +2202,13 @@ class DeviceChecker:
         self._ckpt_frames += 1
         self._ckpt_bytes += nbytes
         self._ckpt_write_s += stall_s
-        self._recover_armed = True
+        self._ckpt_retries += retries
+        self.rec.arm()
         self.last_stats.update(
             ckpt_frames=self._ckpt_frames,
             ckpt_bytes=self._ckpt_bytes,
             ckpt_write_s=round(self._ckpt_write_s, 3),
+            ckpt_retries=self._ckpt_retries,
         )
         self.tel.emit(
             "ckpt_frame",
@@ -2207,6 +2216,7 @@ class DeviceChecker:
             bytes=nbytes,
             write_s=round(write_s, 3),
             stall_s=round(stall_s, 3),
+            retries=retries,
             level=len(level_sizes),
             distinct_states=nv,
         )
@@ -2331,8 +2341,8 @@ class DeviceChecker:
             # not from zero (a resumed run must not re-report them)
             self._fpm_prev = fpm.astype(np.int64)
         if "hbm_recovered" in d:
-            self._hbm_recovered = max(
-                self._hbm_recovered, int(d["hbm_recovered"])
+            self.rec.hbm_recovered = max(
+                self.rec.hbm_recovered, int(d["hbm_recovered"])
             )
         rb = {"row_base": lo, "rows_ok": True}
         self._log(
@@ -2485,12 +2495,13 @@ class DeviceChecker:
                         max(1.0 - nv / vl, 0.0), 4
                     ) if vl else None,
                 )
-        # survivability telemetry for bench artifacts (r7/r8)
+        # survivability telemetry for bench artifacts (r7/r8/r9)
         self.last_stats.update(
             hbm_recovered=self._hbm_recovered,
             ckpt_frames=self._ckpt_frames,
             ckpt_bytes=self._ckpt_bytes,
             ckpt_write_s=round(self._ckpt_write_s, 3),
+            ckpt_retries=self._ckpt_retries,
             host_wait_s=round(getattr(self, "_host_wait_s", 0.0), 3),
             stats_fetches=self._fetch_n,
         )

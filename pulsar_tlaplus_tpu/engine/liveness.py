@@ -38,6 +38,7 @@ Semantics (matching the oracle, pyeval.check_eventually):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -47,9 +48,21 @@ import numpy as np
 from jax import lax
 
 from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.utils import ckpt, faults
 
 TAG = jnp.uint32(1 << 31)
+
+
+class _Preempted(Exception):
+    """Internal: SIGTERM/SIGINT landed and a resumable frame is on
+    disk — unwind to run() with the states-examined count."""
+
+    def __init__(self, n: int, phase: str):
+        super().__init__(phase)
+        self.n = n
+        self.phase = phase
 
 
 @dataclass
@@ -67,6 +80,11 @@ class LivenessResult:
     # visited states and make the sweep assign a query the wrong dst
     # gid (the -2 incomplete-exploration guard cannot catch that case)
     fp_collision_prob: float = 0.0
+    # survivability (r9): a preempted/interrupted run carries NO
+    # verdict — ``holds`` is meaningless while truncated is True;
+    # ``run(resume=True)`` continues from the last frame
+    truncated: bool = False
+    stop_reason: Optional[str] = None
 
 
 class LivenessChecker:
@@ -88,6 +106,11 @@ class LivenessChecker:
         n_devices: int = 1,
         explorer_kw: Optional[dict] = None,
         max_run: int = 1 << 14,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 4,
+        telemetry=None,
+        heartbeat_s: Optional[float] = None,
+        progress: bool = False,
     ):
         goals = getattr(model, "liveness_goals", {})
         if goal not in goals:
@@ -98,6 +121,7 @@ class LivenessChecker:
         if fairness not in ("none", "wf_next"):
             raise ValueError(f"unknown fairness: {fairness}")
         self.model = model
+        self.goal_name = goal
         self.goal_fn = goals[goal]
         self.fairness = fairness
         self.F = frontier_chunk
@@ -126,6 +150,26 @@ class LivenessChecker:
             p *= 2
         self._run_cover = 2 * p - 1
         self.n_devices = n_devices
+        # survivability (r9): the exploration phase checkpoints through
+        # the inner engine's own frame layer at the SAME path; once the
+        # sweep starts, its chunk-boundary frames (which embed the
+        # explored rows) overwrite the exploration frame — one file,
+        # whichever phase died last owns it
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.progress = progress
+        self._telemetry_arg = telemetry
+        self.tel = obs.NULL
+        self.heartbeat_s = heartbeat_s
+        # checkpoint_every units differ by phase (inner: BFS levels;
+        # sweep: chunks) but it is the same "frame cadence" knob —
+        # forward it so a caller asking for tight frames gets them in
+        # BOTH phases (explorer_kw can still override either)
+        inner_kw = dict(
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+        )
+        inner_kw.update(explorer_kw or {})
         if n_devices > 1:
             from pulsar_tlaplus_tpu.engine.sharded_device import (
                 ShardedDeviceChecker,
@@ -139,7 +183,7 @@ class LivenessChecker:
                 sub_batch=max(256, frontier_chunk),
                 visited_cap=visited_cap,
                 max_states=max_states,
-                **(explorer_kw or {}),
+                **inner_kw,
             )
         else:
             from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
@@ -156,7 +200,7 @@ class LivenessChecker:
                 visited_cap=visited_cap,
                 frontier_cap=visited_cap,
                 max_states=max_states,
-                **(explorer_kw or {}),
+                **inner_kw,
             )
         self.keys = self._checker.keys  # shared KeySpec (ADVICE r4)
         self.K = self.keys.ncols
@@ -164,15 +208,64 @@ class LivenessChecker:
         self._rows_flat = None
         self._edge_cache = None  # (src, dst, out_deg) — goal-independent
         self._jits = {}
+        self._diameter = 0
+        self._watcher = None
+        self._observer = None
+        self._resume_explore = False
+        # sweep-resume state: (src_parts, dst_parts, out_deg, chunk0)
+        self._sweep_resume = None
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
+        self._ckpt_retries = 0
+        self._fetch_n = 0
+        self._snap: dict = {}
+        self._run_id: Optional[str] = None
+
+    def _log(self, msg: str):
+        if self.progress:
+            import sys
+
+            print(f"  {msg}", file=sys.stderr, flush=True)
 
     def _explore(self):
         """One exhaustive BFS, cached so several properties (cfg
         PROPERTIES) share the same reachable-set enumeration."""
         if self._explored is not None:
             return self._explored
-        res = self._checker.run()
+        # the inner engine emits into the SAME stream (it never closes
+        # a Telemetry instance it was handed) and runs its own
+        # heartbeat for the exploration phase
+        if self.tel.enabled:
+            self._checker._telemetry_arg = self.tel
+        if self.heartbeat_s and not self._checker.heartbeat_s:
+            self._checker.heartbeat_s = self.heartbeat_s
+        try:
+            res = self._checker.run(resume=self._resume_explore)
+        finally:
+            self._resume_explore = False
+            # the inner run() cleared the fault observer on exit;
+            # re-install ours so sweep-phase drills keep breadcrumbs
+            faults.set_observer(self._observer)
+        if res.truncated and res.stop_reason == "preempted":
+            # exploration wrote its own resumable frame on the way out
+            raise _Preempted(res.distinct_states, "explore")
         if res.truncated:
-            raise RuntimeError("state space exceeded liveness max_states")
+            # a partial graph supports no liveness verdict — and the
+            # remediation depends on WHY it is partial (r9: the inner
+            # engines can now truncate for hbm/time_budget too, where
+            # raising max_states would not help)
+            why = res.stop_reason or "unknown"
+            raise RuntimeError(
+                "liveness exploration truncated before the state "
+                f"space was exhausted (stop_reason={why}); "
+                + (
+                    "raise max_states"
+                    if why == "max_states"
+                    else "the verdict needs the full graph — rerun "
+                    "with more memory/time or a smaller model"
+                )
+            )
         if res.violation is not None:
             # DeviceChecker force-appends __EvalError__ for compiled
             # specs even with invariants=(); ANY early stop means the
@@ -219,6 +312,7 @@ class LivenessChecker:
             if k not in keep:
                 del self._checker.last_bufs[k]
         self._explored = (res.distinct_states, res.level_sizes[0])
+        self._diameter = res.diameter
         return self._explored
 
     def run_goal(self, goal: str) -> LivenessResult:
@@ -226,6 +320,7 @@ class LivenessChecker:
         goals = getattr(self.model, "liveness_goals", {})
         if goal not in goals:
             raise ValueError(f"unknown liveness property: {goal}")
+        self.goal_name = goal
         self.goal_fn = goals[goal]
         return self.run()
 
@@ -398,7 +493,15 @@ class LivenessChecker:
     def _edges(self, n):
         """Goal-independent <Next>_vars edge list (CSR-ready numpy
         int32 arrays) + out-degree per state.  Only the compacted
-        (lane_idx, dst) prefixes cross the tunnel."""
+        (lane_idx, dst) prefixes cross the tunnel.
+
+        Survivability (r9): sweep-chunk boundaries are the liveness
+        engine's frame sites — every ``checkpoint_every`` chunks the
+        accumulated edges (plus the explored rows, so a resumed
+        process needs no re-exploration) go to ``checkpoint_path``
+        through the shared atomic writer; ``kill@sweep:N`` /
+        ``sigterm@sweep:N`` drills fire here, and a preemption request
+        exits resumably after the frame lands."""
         if self._edge_cache is not None:
             return self._edge_cache
         A = self.model.A
@@ -407,9 +510,18 @@ class LivenessChecker:
         targs = self._table_jit(cap)(rows, jnp.int32(n))
         sweep = self._sweep_jit(cap)
         SF = self.SF
+        starts = list(range(0, n, SF))
         src_parts, dst_parts = [], []
         out_deg = np.zeros((n,), np.int64)
-        starts = list(range(0, n, SF))
+        c0 = 0
+        if self._sweep_resume is not None:
+            src_parts, dst_parts, out_deg, c0 = self._sweep_resume
+            self._sweep_resume = None
+            self._log(
+                f"resumed sweep at chunk {c0}/{len(starts)} "
+                f"({sum(len(p) for p in src_parts)} edges so far)"
+            )
+        n_edges = sum(len(p) for p in src_parts)
         # double-buffer: dispatch chunk k+1 before materializing chunk
         # k, so device compute overlaps the ~130 ms / 20 MB/s tunnel
         # readback (chunks are independent).  At big sweep chunks two
@@ -418,10 +530,19 @@ class LivenessChecker:
         # so prefetch is disabled there (the per-chunk readback is a
         # smaller fraction of chunk time at that size anyway).
         prefetch = SF * A <= (1 << 22)
-        pending = [
-            sweep(rows, jnp.int32(starts[0]), jnp.int32(n), *targs)
-        ]
-        for i, start in enumerate(starts):
+        pending = (
+            [sweep(rows, jnp.int32(starts[c0]), jnp.int32(n), *targs)]
+            if c0 < len(starts)
+            else []
+        )
+        for i in range(c0, len(starts)):
+            start = starts[i]
+            # deterministic fault site: sweep chunk i+1 is about to be
+            # consumed (kill/sigterm fire inside poll; an injected oom
+            # raises — the sweep has no degraded-capacity rebuild)
+            kinds = faults.poll("sweep", i + 1)
+            if "oom" in kinds:
+                raise faults.oom_error("sweep", i + 1)
             if not pending:  # serial mode: dispatch this chunk now
                 pending.append(
                     sweep(rows, jnp.int32(start), jnp.int32(n), *targs)
@@ -435,23 +556,52 @@ class LivenessChecker:
                 )
             n_kept, idxc, dstc = pending.pop(0)
             k = int(np.asarray(n_kept))
-            if k == 0:
-                continue
-            idx = np.asarray(idxc[:k]).astype(np.int64)
-            dst = np.asarray(dstc[:k]).view(np.int32).astype(np.int64)
-            if (dst == -2).any():
-                raise RuntimeError(
-                    "edge sweep could not resolve a successor gid: "
-                    "either BFS exploration was incomplete, or one "
-                    f"state has more than {self._run_cover} equal-key "
-                    "predecessors inside a single sweep chunk — "
-                    "shrink sweep_chunk or raise max_run "
-                    f"(currently {self.max_run})"
+            self._fetch_n += 1
+            if k:
+                idx = np.asarray(idxc[:k]).astype(np.int64)
+                dst = np.asarray(dstc[:k]).view(np.int32).astype(
+                    np.int64
                 )
-            uu = start + idx // A
-            src_parts.append(uu)
-            dst_parts.append(dst)
-            np.add.at(out_deg, uu, 1)
+                if (dst == -2).any():
+                    raise RuntimeError(
+                        "edge sweep could not resolve a successor gid: "
+                        "either BFS exploration was incomplete, or one "
+                        f"state has more than {self._run_cover} "
+                        "equal-key predecessors inside a single sweep "
+                        "chunk — shrink sweep_chunk or raise max_run "
+                        f"(currently {self.max_run})"
+                    )
+                uu = start + idx // A
+                src_parts.append(uu)
+                dst_parts.append(dst)
+                np.add.at(out_deg, uu, 1)
+                n_edges += k
+            # progress for the heartbeat (zero extra device syncs: k
+            # was already materialized above) + the stream record
+            swept = min(start + SF, n)
+            self._snap.update(
+                distinct_states=n, level=i + 1, generated=n_edges
+            )
+            self.tel.emit(
+                "sweep",
+                chunk=i + 1,
+                chunks=len(starts),
+                swept=swept,
+                edges=n_edges,
+                wall_s=round(time.time() - self._t0, 3),
+            )
+            done = i + 1 >= len(starts)
+            preempt = (
+                self._watcher is not None and self._watcher.requested
+            )
+            if self.checkpoint_path and not done and (
+                preempt or (i + 1 - c0) % self.checkpoint_every == 0
+            ):
+                self._save_sweep_frame(
+                    n, src_parts, dst_parts, out_deg, i + 1
+                )
+                if preempt:
+                    raise _Preempted(n, "sweep")
         src = (
             np.concatenate(src_parts) if src_parts
             else np.zeros(0, np.int64)
@@ -462,6 +612,112 @@ class LivenessChecker:
         )
         self._edge_cache = (src, dst, out_deg)
         return self._edge_cache
+
+    # ----------------------------------------------- checkpoint/resume
+
+    def _config_sig(self) -> str:
+        """Everything a sweep frame must agree on to be resumable
+        here.  Goal and fairness are NOT part of it: the edge list is
+        goal-independent (run_goal reuses it), and the verdict is
+        recomputed from the restored edges."""
+        inner = self._checker
+        model_sig = inner._model_sig()
+        return ckpt.config_sig(
+            model=model_sig,
+            state_bits=self.model.layout.total_bits,
+            key_cols=self.K,
+            key_exact=self.keys.exact,
+            sweep_chunk=self.SF,
+            engine="liveness_r9",
+        )
+
+    def _save_sweep_frame(
+        self, n, src_parts, dst_parts, out_deg, next_chunk
+    ):
+        """One atomic sweep frame: the explored rows (so resume needs
+        no re-exploration), the accumulated edge list, and the next
+        chunk index.  ``sweep_chunk`` is in the signature because the
+        chunk index is only meaningful at the same SF."""
+        t_stall = time.perf_counter()
+        W = self.model.layout.W
+        n_init = self._explored[1]
+        arrays = {
+            "n": np.int64(n),
+            "n_init": np.int64(n_init),
+            "diameter": np.int64(self._diameter),
+            "next_chunk": np.int64(next_chunk),
+            "rows": np.asarray(self._rows_flat[: n * W]),
+            "src": (
+                np.concatenate(src_parts)
+                if src_parts else np.zeros(0, np.int64)
+            ),
+            "dst": (
+                np.concatenate(dst_parts)
+                if dst_parts else np.zeros(0, np.int64)
+            ),
+            "out_deg": out_deg,
+        }
+        nbytes, write_s, retries = ckpt.save_frame(
+            self.checkpoint_path, self._config_sig(), arrays,
+            wall_s=time.time() - self._t0,
+            meta={
+                "run_id": self._run_id,
+                "frame_seq": self._ckpt_frames + 1,
+                "phase": "sweep",
+                "engine": "liveness",
+            },
+        )
+        stall_s = time.perf_counter() - t_stall
+        self._ckpt_frames += 1
+        self._ckpt_bytes += nbytes
+        self._ckpt_write_s += stall_s
+        self._ckpt_retries += retries
+        self.tel.emit(
+            "ckpt_frame",
+            frame_seq=self._ckpt_frames,
+            bytes=nbytes,
+            write_s=round(write_s, 3),
+            stall_s=round(stall_s, 3),
+            retries=retries,
+            phase="sweep",
+            chunk=next_chunk,
+            distinct_states=n,
+        )
+        self._log(
+            f"sweep checkpoint: chunk {next_chunk}, {n} states "
+            f"({nbytes >> 10} KiB, {stall_s:.2f}s stall) -> "
+            f"{self.checkpoint_path}"
+        )
+
+    def _try_resume_sweep(self) -> bool:
+        """Load a sweep-phase frame if that is what ``checkpoint_path``
+        holds; an exploration-phase frame (the inner engine's
+        signature) returns False so the caller resumes exploration
+        instead.  A missing file raises FileNotFoundError untouched."""
+        try:
+            d = ckpt.load_frame(self.checkpoint_path, self._config_sig())
+        except FileNotFoundError:
+            raise
+        except ValueError:
+            return False  # an exploration-phase (inner-engine) frame
+        n = int(d["n"])
+        self._explored = (n, int(d["n_init"]))
+        self._diameter = int(d["diameter"])
+        self._rows_flat = jnp.asarray(np.asarray(d["rows"], np.uint32))
+        src = np.asarray(d["src"], np.int64)
+        dst = np.asarray(d["dst"], np.int64)
+        self._sweep_resume = (
+            [src] if len(src) else [],
+            [dst] if len(dst) else [],
+            np.asarray(d["out_deg"], np.int64),
+            int(d["next_chunk"]),
+        )
+        self._resume_meta = ckpt.frame_meta(d)
+        self._log(
+            f"resuming the edge sweep from chunk {int(d['next_chunk'])}"
+            f" ({n} explored states restored, no re-exploration)"
+        )
+        return True
 
     def _table_cap(self, n: int) -> int:
         # round up to a multiple of the sweep chunk (itself a multiple
@@ -488,8 +744,154 @@ class LivenessChecker:
             )
         return self._rows_flat
 
-    def run(self) -> LivenessResult:
+    def run(self, resume: bool = False) -> LivenessResult:
+        """Check the current goal.  ``resume=True`` continues an
+        interrupted run from ``checkpoint_path``: a sweep-phase frame
+        restores the explored rows + accumulated edges (no
+        re-exploration); an exploration-phase frame resumes the inner
+        engine's BFS first.  SIGTERM/SIGINT during the run exit
+        resumably with ``stop_reason="preempted"``."""
+        t0 = time.time()
+        self._t0 = t0
+        rid = obs.new_run_id()
+        self.tel = obs.as_telemetry(self._telemetry_arg, run_id=rid)
+        self._run_id = self.tel.run_id or rid
+        self._resume_meta = {}
+        self._snap = {"distinct_states": 0}
+        self._fetch_n = 0
+        # a fresh run() must not inherit a previous run's frame counts
+        # (run_goal reuses this checker across properties)
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
+        self._ckpt_write_s = 0.0
+        self._ckpt_retries = 0
+        # a crash mid-frame-write can leave a dead tmp file behind
+        ckpt.cleanup_stale_tmp(self.checkpoint_path)
+        # crash breadcrumbs FIRST: fault events flush before the fault
+        # fires (kill@sweep leaves no other trace)
+        self._observer = (
+            lambda kind, site, count: self.tel.emit(
+                "fault", kind=kind, site=site, count=count
+            )
+        )
+        faults.set_observer(self._observer)
+        # the liveness heartbeat covers the SWEEP phase (started after
+        # exploration, whose own engine heartbeats itself) — reporting
+        # from _snap, which the chunk loop updates: zero extra syncs
+        self._hb = (
+            obs.Heartbeat(
+                self.heartbeat_s, self._snap, telemetry=self.tel
+            )
+            if self.heartbeat_s
+            else None
+        )
+        watcher = ckpt.PreemptionWatcher(
+            enabled=bool(self.checkpoint_path), log=self._log
+        )
+        self._watcher = watcher
+        try:
+            with watcher:
+                if resume:
+                    if not self.checkpoint_path:
+                        raise ValueError(
+                            "resume requires checkpoint_path"
+                        )
+                    if not self._try_resume_sweep():
+                        # the frame on disk is an exploration-phase
+                        # one — resume the inner engine's BFS instead
+                        self._resume_explore = True
+                self._emit_header(resume)
+                try:
+                    lres = self._check()
+                except _Preempted as p:
+                    import os
+
+                    # the promise must be honest: a preemption before
+                    # the first frame landed is NOT resumable
+                    has_frame = bool(self.checkpoint_path) and (
+                        os.path.exists(self.checkpoint_path)
+                    )
+                    lres = LivenessResult(
+                        False,
+                        "preempted (SIGTERM/SIGINT) during the "
+                        f"{p.phase} phase — "
+                        + (
+                            "a resumable frame is on disk; continue "
+                            "with run(resume=True)"
+                            if has_frame
+                            else "no frame was written yet; the run "
+                            "is NOT resumable"
+                        ),
+                        p.n,
+                        truncated=True,
+                        stop_reason="preempted",
+                    )
+                self.tel.emit(
+                    "result",
+                    distinct_states=lres.distinct_states,
+                    diameter=self._diameter,
+                    wall_s=round(time.time() - t0, 3),
+                    truncated=lres.truncated,
+                    stop_reason=lres.stop_reason,
+                    holds=None if lres.truncated else lres.holds,
+                    reason=lres.reason,
+                    goal=self.goal_name,
+                    fairness=self.fairness,
+                    ckpt_frames=self._ckpt_frames,
+                    ckpt_retries=self._ckpt_retries,
+                )
+                return lres
+        except BaseException as e:
+            self.tel.emit("error", error=repr(e)[:300])
+            raise
+        finally:
+            if self._hb is not None:
+                self._hb.stop()
+                self._hb = None
+            faults.set_observer(None)
+            self._observer = None
+            self._watcher = None
+            if obs.owns_stream(self._telemetry_arg):
+                self.tel.close()
+            self.tel = obs.NULL
+
+    def _emit_header(self, resume: bool):
+        if not self.tel.enabled:
+            return
+        try:
+            dev = str(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — headers must never kill a run
+            dev = "unknown"
+        f = dict(
+            engine="liveness",
+            device=dev,
+            visited_impl=self._checker.visited_impl,
+            config_sig=self._config_sig(),
+            wall_unix=round(time.time(), 3),
+            goal=self.goal_name,
+            fairness=self.fairness,
+            n_devices=self.n_devices,
+            sweep_chunk=self.SF,
+            resume=resume,
+        )
+        rm = self._resume_meta
+        if resume and rm:
+            if rm.get("run_id"):
+                f["resume_of"] = rm["run_id"]
+            if rm.get("frame_seq") is not None:
+                f["resume_frame_seq"] = rm["frame_seq"]
+        self.tel.emit("run_header", **f)
+
+    def _check(self) -> LivenessResult:
         n, n_init = self._explore()
+        if self._watcher is not None and self._watcher.requested:
+            # preemption landed during/after exploration: the inner
+            # engine already wrote its frame on the way out — exit
+            # before starting a sweep nobody will read
+            raise _Preempted(n, "explore")
+        if self._hb is not None:
+            self._snap["distinct_states"] = n
+            self._hb.start()
         cap = self._table_cap(n)
         rows = self._rows_padded(cap)
         goal = np.asarray(self._goal_jit(cap)(rows, jnp.int32(n)))[:n]

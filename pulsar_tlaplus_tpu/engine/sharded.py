@@ -118,6 +118,7 @@ class ShardedChecker:
         self._snap: Dict[str, object] = {}
         self._resume_meta: Dict[str, object] = {}
         self._ckpt_frames = 0
+        self._ckpt_retries = 0
         self._ckpt_bytes = 0
         self._ckpt_write_s = 0.0
 
@@ -409,7 +410,7 @@ class ShardedChecker:
 
         t_stall = time.perf_counter()
         total = sum(len(f) for f in frontier)
-        nbytes, write_s = ckpt.save_frame(
+        nbytes, write_s, retries = ckpt.save_frame(
             self.checkpoint_path,
             self._config_sig(),
             dict(
@@ -448,12 +449,14 @@ class ShardedChecker:
         self._ckpt_frames += 1
         self._ckpt_bytes += nbytes
         self._ckpt_write_s += stall_s
+        self._ckpt_retries += retries
         self.tel.emit(
             "ckpt_frame",
             frame_seq=self._ckpt_frames,
             bytes=nbytes,
             write_s=round(write_s, 3),
             stall_s=round(stall_s, 3),
+            retries=retries,
             level=len(level_sizes),
             distinct_states=int(np.asarray(n_visited).sum()),
         )
@@ -472,8 +475,13 @@ class ShardedChecker:
         self._snap = {"distinct_states": 0}
         self._resume_meta = {}
         self._ckpt_frames = 0
+        self._ckpt_retries = 0
         self._ckpt_bytes = 0
         self._ckpt_write_s = 0.0
+        # a crash mid-frame-write can leave a dead tmp file behind
+        from pulsar_tlaplus_tpu.utils import ckpt
+
+        ckpt.cleanup_stale_tmp(self.checkpoint_path)
         hb = None
         if self.heartbeat_s:
             hb = obs.Heartbeat(
@@ -637,6 +645,7 @@ class ShardedChecker:
                     "ckpt_frames": self._ckpt_frames,
                     "ckpt_bytes": self._ckpt_bytes,
                     "ckpt_write_s": round(self._ckpt_write_s, 3),
+                    "ckpt_retries": self._ckpt_retries,
                     "n_shards": self.n_shards,
                 },
             )

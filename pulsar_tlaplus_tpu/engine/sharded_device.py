@@ -52,13 +52,18 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from pulsar_tlaplus_tpu.obs import telemetry as obs
-from pulsar_tlaplus_tpu.utils import ckpt, device, faults
+from pulsar_tlaplus_tpu.utils import ckpt, device, faults, recovery
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.ops import dedup, fpset
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
 BIG = jnp.int32(2**31 - 1)
+
+# per-shard zero-sync fpset metrics vector [flushes, probe_rounds,
+# failures, valid_lanes, max_probe_rounds] — widened 3 -> 5 in r9 to
+# match the single-chip engine (ops/fpset.py is the shared source)
+FPM_N = fpset.FPM_N
 TAG_BIT = jnp.uint32(1 << 31)
 IDX_MASK = jnp.uint32((1 << 31) - 1)
 
@@ -386,12 +391,18 @@ class ShardedDeviceChecker:
         self.time_budget_s = time_budget_s
         self.progress = progress
         self.metrics_path = metrics_path
-        self.group = group
+        # mesh-wide HBM-recovery bookkeeping shared with the
+        # single-chip engine (utils/recovery.py, r9): armed frames,
+        # recovery count, degraded group-ahead + frozen headroom
+        self.rec = recovery.RecoveryState(checkpoint_path, group)
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self._ckpt_frames = 0
         self._ckpt_bytes = 0
         self._ckpt_write_s = 0.0
+        self._ckpt_retries = 0
+        self._bufs_poisoned = False
+        self._flush_seq = 0
         self._watcher = None
         self._jits: Dict[tuple, object] = {}
         self.last_stats: Dict[str, float] = {}
@@ -404,10 +415,24 @@ class ShardedDeviceChecker:
         self._run_id: Optional[str] = None
         self._snap: Dict[str, object] = {}
         self._fetch_n = 0
-        self._fpm_prev = np.zeros((3,), np.int64)
+        self._fpm_prev = np.zeros((FPM_N,), np.int64)
         self._resume_meta: Dict[str, object] = {}
 
     # -------------------------------------------------------------- util
+
+    # recovery bookkeeping delegates (utils/recovery.py is the one
+    # source of truth; these keep the engine's established names)
+    @property
+    def group(self) -> int:
+        return self.rec.group
+
+    @property
+    def _hbm_recovered(self) -> int:
+        return self.rec.hbm_recovered
+
+    @property
+    def _headroom_frozen(self) -> bool:
+        return self.rec.headroom_frozen
 
     def _calc_route(self):
         """Derive every route-capacity-dependent size from the current
@@ -784,7 +809,19 @@ class ShardedDeviceChecker:
                 )
                 n_new_owner = jnp.sum(is_new.astype(jnp.int32))
                 flag_own = is_new.astype(jnp.uint32)
-                fpm = fpm + jnp.stack([jnp.int32(1), rounds, n_failed])
+                # 5-wide zero-sync metrics (r9, = device_bfs.FPM_N):
+                # valid_lanes is the routed-candidate count after
+                # masking (duplicate-rate denominator); col 4 is the
+                # worst flush's probe depth (running max, not a sum)
+                fpm = jnp.stack(
+                    [
+                        fpm[0] + 1,
+                        fpm[1] + rounds,
+                        fpm[2] + n_failed,
+                        fpm[3] + jnp.sum(valid.astype(jnp.int32)),
+                        jnp.maximum(fpm[4], rounds),
+                    ]
+                )
             else:
                 ccols = tuple(
                     jnp.where(amask, a, SENTINEL) for a in ak
@@ -1253,6 +1290,16 @@ class ShardedDeviceChecker:
         )
         while self.LCAP < need:
             pad = min(self.LCAP, max(cap - self.LCAP, need - self.LCAP))
+            if self.rec.headroom_frozen:
+                # reduced per-shard row budget after an HBM recovery:
+                # grow to EXACTLY the capacity the pending flushes
+                # need, never the doubling overshoot (per-shard rows
+                # grow toward SCAP/N; the overshoot is what exhausted
+                # the mesh).  The blind-DUS bound still holds — only
+                # the speculative headroom is gone; if even this
+                # minimal growth re-exhausts, the unarmed recovery
+                # state truncates honestly (stop_reason="hbm").
+                pad = need - self.LCAP
             bufs["rows"] = jnp.concatenate(
                 [
                     bufs["rows"],
@@ -1336,6 +1383,11 @@ class ShardedDeviceChecker:
         (utils/ckpt.py); fpset visited sets use the compacted-occupancy
         codec — only occupied slots (keys + slot index) are stored, so
         frame size scales with the state count, not the table tier."""
+        if self._bufs_poisoned:
+            # device buffers hold donated/poisoned storage after an
+            # unrecovered exhaustion — keep the previous (older but
+            # valid) frame rather than overwrite it with garbage
+            return
         t_stall = time.perf_counter()
         nvis = np.asarray(st["n_visited"]).astype(np.int64)
         nkeys = np.asarray(st["n_keys"]).astype(np.int64)
@@ -1352,7 +1404,7 @@ class ShardedDeviceChecker:
                 f"vk{i}": np.asarray(col[:, :mk])
                 for i, col in enumerate(bufs["vk"])
             }
-        nbytes, write_s = ckpt.save_frame(
+        nbytes, write_s, retries = ckpt.save_frame(
             self.checkpoint_path,
             self._config_sig(),
             dict(
@@ -1365,6 +1417,7 @@ class ShardedDeviceChecker:
                 level_sizes=np.asarray(level_sizes, np.int64),
                 lb=np.asarray(lb, np.int64),
                 nf=np.asarray(nf, np.int64),
+                hbm_recovered=np.int64(self._hbm_recovered),
             ),
             wall_s=time.time() - t0,
             meta={
@@ -1378,10 +1431,15 @@ class ShardedDeviceChecker:
         self._ckpt_frames += 1
         self._ckpt_bytes += nbytes
         self._ckpt_write_s += stall_s
+        self._ckpt_retries += retries
+        # a fresh frame re-arms mesh-wide OOM recovery (consumed by
+        # the next rebuild; see utils/recovery.py)
+        self.rec.arm()
         self.last_stats.update(
             ckpt_frames=self._ckpt_frames,
             ckpt_bytes=self._ckpt_bytes,
             ckpt_write_s=round(self._ckpt_write_s, 3),
+            ckpt_retries=self._ckpt_retries,
         )
         self.tel.emit(
             "ckpt_frame",
@@ -1389,6 +1447,7 @@ class ShardedDeviceChecker:
             bytes=nbytes,
             write_s=round(write_s, 3),
             stall_s=round(stall_s, 3),
+            retries=retries,
             level=len(level_sizes),
             distinct_states=int(nvis.sum()),
         )
@@ -1489,13 +1548,22 @@ class ShardedDeviceChecker:
             "dead": self._dev_fill((N,), int(BIG), jnp.int32),
             "viol": self._dev_fill((N, n_inv), int(BIG), jnp.int32),
             "ovf": self._dev_fill((N,), 0, jnp.bool_),
-            "fpm": self._dev_fill((N, 3), 0, jnp.int32),
+            "fpm": self._dev_fill((N, FPM_N), 0, jnp.int32),
         }
         if self.visited_impl == "fpset":
             # the next flush may add a full accumulator of owned keys
             # per shard; grow (rehash) now if the snapshot tier cannot
             # absorb that at load <= 1/2
             self._grow_visited(bufs, mk + self.ACAP)
+        if "hbm_recovered" in d:
+            # pre-r9 frames predate the field and restore at 0
+            self.rec.hbm_recovered = max(
+                self.rec.hbm_recovered, int(d["hbm_recovered"])
+            )
+        # the device fpm counters restart at zero after a restore;
+        # flush-telemetry deltas must restart with them or every
+        # record until the old totals are re-exceeded is suppressed
+        self._fpm_prev = np.zeros((FPM_N,), np.int64)
         return (
             bufs, st, [int(x) for x in d["level_sizes"]],
             d["lb"].astype(np.int64), d["nf"].astype(np.int64),
@@ -1545,7 +1613,7 @@ class ShardedDeviceChecker:
         viol = self._dev_fill((N, n_inv), int(BIG), jnp.int32)
         nvis = self._dev_fill((N,), 0, jnp.int32)
         nkeys = self._dev_fill((N,), 0, jnp.int32)
-        fpm = self._dev_fill((N, 3), 0, jnp.int32)
+        fpm = self._dev_fill((N, FPM_N), 0, jnp.int32)
         mark("alloc")
         out = self._init_round_jit()(
             bufs["ak"], bufs["arows"], bufs["apar"], bufs["alane"],
@@ -1628,20 +1696,33 @@ class ShardedDeviceChecker:
         self._run_id = self.tel.run_id or rid
         self._snap = {"distinct_states": 0}
         self._fetch_n = 0
+        # per-run recovery/frame state: a fresh run() must not inherit
+        # a previous run's degraded capacity or frame counts
+        self.rec.reset()
+        self._ckpt_frames = 0
+        self._ckpt_bytes = 0
         self._ckpt_write_s = 0.0
-        self._fpm_prev = np.zeros((3,), np.int64)
+        self._ckpt_retries = 0
+        self._bufs_poisoned = False
+        self._flush_seq = 0
+        self._fpm_prev = np.zeros((FPM_N,), np.int64)
         self._resume_meta = {}
+        # a crash mid-frame-write can leave a dead multi-GB tmp behind
+        ckpt.cleanup_stale_tmp(self.checkpoint_path)
+        # crash breadcrumbs: installed FIRST — before the heartbeat or
+        # any warmup-adjacent dispatch — so even a level-1/flush-1
+        # drill leaves its breadcrumb (the null sink makes this a
+        # no-op when telemetry is off)
+        faults.set_observer(
+            lambda kind, site, count: self.tel.emit(
+                "fault", kind=kind, site=site, count=count
+            )
+        )
         hb = None
         if self.heartbeat_s:
             hb = obs.Heartbeat(
                 self.heartbeat_s, self._snap, telemetry=self.tel,
                 capacity=self.SCAP,
-            )
-        if self.tel.enabled:
-            faults.set_observer(
-                lambda kind, site, count: self.tel.emit(
-                    "fault", kind=kind, site=site, count=count
-                )
             )
         # preemption-safe shutdown: SIGTERM/SIGINT request a checkpoint
         # at the next level boundary (armed only with a frame path)
@@ -1716,6 +1797,7 @@ class ShardedDeviceChecker:
                 bufs, st, level_sizes, lb, nf, saved_wall,
             ) = self._restore(d)
             t0 = time.time() - saved_wall
+            self.rec.arm()  # the on-disk frame is valid
             self._host_wait_s = 0.0
             self._emit_header(resume=True)
             return self._run_levels(t0, bufs, st, level_sizes, lb, nf)
@@ -1739,7 +1821,7 @@ class ShardedDeviceChecker:
             "dead": self._dev_fill((N,), int(BIG), jnp.int32),
             "viol": self._dev_fill((N, n_inv), int(BIG), jnp.int32),
             "ovf": self._dev_fill((N,), 0, jnp.bool_),
-            "fpm": self._dev_fill((N, 3), 0, jnp.int32),
+            "fpm": self._dev_fill((N, FPM_N), 0, jnp.int32),
         }
         self._host_wait_s = 0.0
         self._emit_header(resume=False)
@@ -1767,6 +1849,12 @@ class ShardedDeviceChecker:
             )
 
         # ---- level 1: initial states (keys to owners, rows local) ----
+        # level-1 fault site: the level loop's poll counts start at 2,
+        # so without this a kill@level:1 drill would never fire (the
+        # breadcrumb observer is already installed above)
+        kinds = faults.poll("level", 1)
+        if "oom" in kinds:
+            raise faults.oom_error("level", 1)
         n_init = m.n_initial
         if n_init > self.SCAP:
             raise ValueError("initial-state set exceeds max_states")
@@ -1825,7 +1913,8 @@ class ShardedDeviceChecker:
         count, 1 = per-shard owned-key count, 2 = deadlock gid, 3.. =
         per-invariant violation gids, then the routing-overflow flag
         and the per-shard fpset metrics [flushes, probe rounds,
-        failures] (zeros in sort mode)."""
+        failures, valid lanes, max probe rounds] (zeros in sort
+        mode)."""
         tf = time.time()
         out = np.asarray(
             self._stats_jit()(
@@ -1840,11 +1929,16 @@ class ShardedDeviceChecker:
         self._snap["distinct_states"] = nv
         if out[:, 3 + n_inv].any():
             raise _RouteOverflow
-        self._last_fpm = out[:, 4 + n_inv: 7 + n_inv]
+        self._last_fpm = out[:, 4 + n_inv: 4 + n_inv + FPM_N]
         if self.visited_impl == "fpset":
             self._snap["occupancy"] = float(out[:, 1].max()) / max(
                 self.TCAP, 1
             )
+            if self._last_fpm.shape[1] >= 4:
+                # TLC's "states generated": routed lanes examined
+                self._snap["generated"] = int(
+                    self._last_fpm[:, 3].sum()
+                )
             self._emit_flush_event(nv, out)
         if self._last_fpm[:, 2].any():
             # probe overflow: some owner table dropped routed keys in a
@@ -1860,10 +1954,12 @@ class ShardedDeviceChecker:
     def _emit_flush_event(self, nv: int, stats):
         """One telemetry record per stats fetch, covering the flushes
         since the last one (mesh-summed deltas of the per-shard
-        device counters) — per-flush visibility, zero extra syncs."""
+        device counters; max_probe_rounds is a mesh MAX, not a sum) —
+        per-flush visibility, zero extra syncs."""
         if not self.tel.enabled or self._last_fpm is None:
             return
-        cur = np.asarray(self._last_fpm, np.int64).sum(axis=0)
+        per = np.asarray(self._last_fpm, np.int64)
+        cur = np.concatenate([per[:, :4].sum(axis=0), [per[:, 4].max()]])
         d = cur - self._fpm_prev
         if d[0] <= 0:
             return
@@ -1873,8 +1969,9 @@ class ShardedDeviceChecker:
             flushes=int(d[0]),
             probe_rounds=int(d[1]),
             failures=int(d[2]),
-            valid_lanes=0,  # not accumulated on this engine yet
+            valid_lanes=int(d[3]),
             avg_probe_rounds=round(int(d[1]) / max(int(d[0]), 1), 2),
+            max_probe_rounds=int(cur[4]),
             occupancy=round(
                 float(stats[:, 1].max()) / max(self.TCAP, 1), 4
             ),
@@ -1882,6 +1979,23 @@ class ShardedDeviceChecker:
         )
 
     def _flush(self, bufs, st, n_acc: int):
+        # deterministic fault site (utils/faults.py): oom@flush:N hits
+        # the sharded fpset flush — raised BEFORE the dispatch mutates
+        # any device buffer, so a recovery retry of the level is exact;
+        # fpset_fail@flush:N accounts one synthetic dropped lane in the
+        # device metrics and the next stats fetch fail-stops exactly
+        # like a real probe overflow would
+        self._flush_seq += 1
+        kinds = faults.poll("flush", self._flush_seq)
+        if "oom" in kinds:
+            raise faults.oom_error("flush", self._flush_seq)
+        if "fpset_fail" in kinds and self.visited_impl == "fpset":
+            # one synthetic dropped lane on ONE shard (shard 0) — a
+            # full-mesh broadcast would misstate the drill's blast
+            # radius in the failure telemetry and the abort message
+            bump = np.zeros((self.N, FPM_N), np.int32)
+            bump[0, 2] = 1
+            st["fpm"] = st["fpm"] + jnp.asarray(bump)
         out = self._flush_jit()(
             bufs["vk"], bufs["ak"], bufs["aq"], bufs["aq2"],
             st["n_keys"], st["fpm"], jnp.int32(n_acc),
@@ -1923,10 +2037,99 @@ class ShardedDeviceChecker:
         )
 
     def _run_levels(self, t0, bufs, st, level_sizes, lb, nf, stats=None):
-        """The BFS level loop over a restored-or-fresh level frame."""
-        N = self.N
+        """The BFS level loop under the mesh-wide HBM-exhaustion
+        recovery contract (r9): a ``RESOURCE_EXHAUSTED`` anywhere in a
+        level — dispatch, fetch, or the injected ``oom@level/flush``
+        drills — with a valid checkpoint frame on disk frees every
+        per-shard buffer, rebuilds the sharded FPSet + frontier from
+        the frame at degraded capacity (halved group-ahead, frozen
+        growth headroom, reduced per-shard row budget — see
+        ``_grow_store``), and resumes the level.  Every state the
+        partial attempt appended dedups to a no-op, so counts and gids
+        stay exact — the same contract as the single-chip engine, on
+        the mesh as the unit of failure.  Only when recovery itself
+        exhausts memory (or no fresh frame was written since the last
+        recovery) does the run truncate with ``stop_reason="hbm"``."""
+        while True:
+            try:
+                return self._level_loop(
+                    t0, bufs, st, level_sizes, lb, nf, stats
+                )
+            except recovery.HbmExhausted as hx:
+                last = (hx.nv, hx.level_sizes, hx.msg)
+                # the rebuild happens OUTSIDE this except block: the
+                # traceback pins _level_loop's frame locals (per-shard
+                # accumulators) plus the chained XLA error — restoring
+                # under it would re-OOM exactly when memory is tightest
+            self.rec.degrade()
+            self.tel.emit(
+                "hbm_recovery",
+                recovery_n=self._hbm_recovered,
+                group=self.group,
+                distinct_states=last[0],
+                error=last[2][:200],
+            )
+            self._log(
+                "HBM exhausted on the mesh: recovering from the last "
+                f"checkpoint frame (recovery #{self._hbm_recovered}"
+                f", group={self.group}) — {last[2][:120]}"
+            )
+            # drop every per-shard buffer reference BEFORE the restore
+            # allocates: the poisoned/donated storage must be freed
+            # first or the rebuild would OOM on top of it
+            bufs.clear()
+            st.clear()
+            try:
+                d = self.load_checkpoint()
+                nbufs, nst, level_sizes, lb, nf, _w = self._restore(d)
+                bufs.update(nbufs)
+                st.update(nst)
+                # the post-rebuild fetch happens HERE, inside the
+                # recovery handler: it is the first dispatch after the
+                # rebuild and the likeliest to re-OOM — it must take
+                # the honest-truncate path, not crash the run
+                stats = self._fetch(st)
+            except Exception as e:  # noqa: BLE001
+                if not recovery.is_resource_exhausted(e):
+                    raise
+                # recovery itself exhausted memory: report what the
+                # interrupted run had verified, honestly
+                self._bufs_poisoned = True
+                return self._hbm_result(t0, last[0], last[1])
+
+    def _hbm_result(self, t0, nv: int, level_sizes) -> CheckerResult:
+        """Truncated stop_reason="hbm" result from the last known
+        totals — the per-shard stats matrix is gone (poisoned or never
+        fetched), so a minimal one carries the mesh total."""
+        n_inv = len(self.invariant_names)
+        stats = np.zeros((self.N, 4 + n_inv + FPM_N), np.int64)
+        stats[:, 2] = int(BIG)
+        stats[:, 3: 3 + n_inv] = int(BIG)
+        stats[0, 0] = nv
+        return self._result(
+            t0, stats, level_sizes, {}, truncated=True,
+            stop_reason="hbm",
+        )
+
+    def _level_loop(self, t0, bufs, st, level_sizes, lb, nf, stats=None):
+        """One pass of BFS levels over a restored-or-fresh level frame
+        (re-entered by ``_run_levels`` after an HBM recovery)."""
         if stats is None:
-            stats = self._fetch(st)
+            # resume entry: the first fetch after a restore gets the
+            # same recovery contract as any in-level exhaustion (the
+            # frame on disk is armed, so a rebuild retry is legal; the
+            # pre-fetch state count is unknown — report level_sizes)
+            try:
+                stats = self._fetch(st)
+            except Exception as e:  # noqa: BLE001
+                if not recovery.is_resource_exhausted(e):
+                    raise
+                if self.rec.can_recover():
+                    raise recovery.HbmExhausted(
+                        0, list(level_sizes), repr(e)
+                    )
+                self._bufs_poisoned = True
+                return self._hbm_result(t0, 0, list(level_sizes))
         nv = stats[:, 0].copy()
         while True:
             reason = self._stop_reason(stats, t0)
@@ -1951,13 +2154,16 @@ class ShardedDeviceChecker:
                     t0, stats, level_sizes, bufs, truncated=True,
                     stop_reason="preempted",
                 )
-            # deterministic fault sites (utils/faults.py): kill/sigterm
-            # fire inside poll; oom is not recoverable on this engine
-            # (no degraded-capacity rebuild yet) so it raises through
-            kinds = faults.poll("level", len(level_sizes) + 1)
-            if "oom" in kinds:
-                raise faults.oom_error("level", len(level_sizes) + 1)
             try:
+                # deterministic fault sites (utils/faults.py): kill/
+                # sigterm fire inside poll; an injected oom raises the
+                # same RESOURCE_EXHAUSTED path a real allocator
+                # failure takes — recovered mesh-wide below (r9)
+                kinds = faults.poll("level", len(level_sizes) + 1)
+                if "oom" in kinds:
+                    raise faults.oom_error(
+                        "level", len(level_sizes) + 1
+                    )
                 stats, nv2, stop = self._run_one_level(
                     t0, bufs, st, stats, nv, lb, nf
                 )
@@ -1966,6 +2172,24 @@ class ShardedDeviceChecker:
                 stats = self._fetch(st)
                 nv = stats[:, 0].copy()
                 continue  # retry the same level at doubled capacity
+            except Exception as e:  # noqa: BLE001
+                if not recovery.is_resource_exhausted(e):
+                    raise
+                if self.rec.can_recover():
+                    raise recovery.HbmExhausted(
+                        int(nv.sum()), list(level_sizes), repr(e)
+                    )
+                # HBM exhausted with no frame to rebuild from: report
+                # what was checked so far (truncated).  The per-shard
+                # buffers may hold donated/poisoned storage — only
+                # host-side totals are reported from here on.
+                self._log(
+                    f"HBM exhausted mid-level: truncating ({e!r:.120})"
+                )
+                self._bufs_poisoned = True
+                return self._hbm_result(
+                    t0, int(nv.sum()), list(level_sizes)
+                )
             level_count = (nv2 - (lb + nf)).sum()
             if level_count or stop:
                 level_sizes.append(int(max(level_count, 0)))
@@ -2071,13 +2295,23 @@ class ShardedDeviceChecker:
                 if self._stop_reason(stats, t0) is not None:
                     stop = True
                     break
-                if nk_bound + (self.group + 1) * self.ACAP > self.VCAP:
-                    self._grow_visited(
-                        bufs,
-                        int(nk_bound) + (self.group + 1) * self.ACAP,
-                    )
-                if nv_bound + (self.group + 1) * self.PACAP + self.APAD \
-                        > self.LCAP:
+                # growth headroom for a full group of in-flight
+                # flushes — except after an HBM recovery, where it is
+                # FROZEN at one accumulator (degraded capacity so the
+                # retry fits where the full-headroom run did not)
+                head_k = (
+                    self.ACAP
+                    if self.rec.headroom_frozen
+                    else (self.group + 1) * self.ACAP
+                )
+                head_p = (
+                    self.PACAP
+                    if self.rec.headroom_frozen
+                    else (self.group + 1) * self.PACAP
+                )
+                if nk_bound + head_k > self.VCAP:
+                    self._grow_visited(bufs, int(nk_bound) + head_k)
+                if nv_bound + head_p + self.APAD > self.LCAP:
                     # headroom for a full group of in-flight flushes,
                     # but never beyond what the state cap (plus one
                     # overshooting flush) can actually use.  The cap is
@@ -2089,8 +2323,7 @@ class ShardedDeviceChecker:
                     self._grow_store(
                         bufs,
                         min(
-                            int(nv_bound)
-                            + (self.group + 1) * self.PACAP,
+                            int(nv_bound) + head_p,
                             self.SCAP + self.PACAP,
                         )
                         + self.APAD,
@@ -2234,10 +2467,27 @@ class ShardedDeviceChecker:
                     float(stats[:, 1].max()) / max(self.TCAP, 1), 4
                 ),
             )
+            if self._last_fpm.shape[1] >= FPM_N:
+                # zero-sync device counters (r9, = device_bfs): routed
+                # lanes after validity masking (duplicate-rate
+                # denominator) and the worst single flush's probe
+                # depth anywhere on the mesh
+                vl = int(self._last_fpm[:, 3].sum())
+                self.last_stats.update(
+                    fpset_valid_lanes=vl,
+                    fpset_max_probe_rounds=int(
+                        self._last_fpm[:, 4].max()
+                    ),
+                    fpset_duplicate_ratio=round(
+                        max(1.0 - nv / vl, 0.0), 4
+                    ) if vl else None,
+                )
         self.last_stats.update(
+            hbm_recovered=self._hbm_recovered,
             ckpt_frames=self._ckpt_frames,
             ckpt_bytes=self._ckpt_bytes,
             ckpt_write_s=round(self._ckpt_write_s, 3),
+            ckpt_retries=self._ckpt_retries,
             host_wait_s=round(self._host_wait_s, 3),
             stats_fetches=self._fetch_n,
         )
@@ -2250,6 +2500,7 @@ class ShardedDeviceChecker:
             level_sizes=level_sizes,
             truncated=truncated,
             stop_reason=stop_reason if truncated else None,
+            hbm_recovered=self._hbm_recovered,
             fp_collision_prob=self.keys.collision_prob(nv),
         )
         gid = None
@@ -2261,9 +2512,18 @@ class ShardedDeviceChecker:
             gid = dead_gid
         if gid is not None:
             res.violation_gid = gid
-            res.trace, res.trace_actions = self._trace(
-                bufs, gid, len(level_sizes) + 2
-            )
+            if self._bufs_poisoned:
+                # after an unrecovered RESOURCE_EXHAUSTED the per-shard
+                # trace logs may hold donated/poisoned storage —
+                # walking them could crash or fabricate a trace; report
+                # the verdict without one
+                res.trace = None
+                res.trace_actions = None
+                res.truncated = True
+            else:
+                res.trace, res.trace_actions = self._trace(
+                    bufs, gid, len(level_sizes) + 2
+                )
         self.tel.emit(
             "result",
             distinct_states=nv,
@@ -2275,6 +2535,7 @@ class ShardedDeviceChecker:
             violation=res.violation,
             violation_gid=res.violation_gid,
             deadlock=res.deadlock,
+            hbm_recovered=self._hbm_recovered,
             level_sizes=[int(x) for x in level_sizes],
             fp_collision_prob=res.fp_collision_prob,
             stats={

@@ -201,6 +201,10 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
                 3,
             ),
         )
+        out.setdefault(
+            "ckpt_retries",
+            sum(int(e.get("retries", 0)) for e in frames),
+        )
     flushes = [e for e in events if e.get("event") == "flush"]
     if flushes and "fpset_flushes" not in out:
         fl = sum(int(e.get("flushes", 0)) for e in flushes)

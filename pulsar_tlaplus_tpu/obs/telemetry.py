@@ -40,12 +40,23 @@ import time
 import uuid
 from typing import Callable, Dict, Optional, Tuple, Union
 
-SCHEMA_VERSION = 1
+# v1: the round-8 stream.  v2 (round 9): ``ckpt_frame`` records carry
+# the frame writer's ``retries`` count, and the liveness engine emits
+# ``sweep`` records.  Validators accept <= SCHEMA_VERSION and hold a
+# record only to the fields its OWN version requires (FIELD_SINCE) —
+# pre-r9 streams stay valid.
+SCHEMA_VERSION = 2
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
 # must still carry the base envelope.
 BASE_FIELDS: Tuple[str, ...] = ("v", "event", "t", "seq", "run_id")
+
+# required fields introduced AFTER schema v1: (event, field) -> the
+# version that added it.  The validator skips them for older records.
+FIELD_SINCE: Dict[Tuple[str, str], int] = {
+    ("ckpt_frame", "retries"): 2,
+}
 EVENTS: Dict[str, Tuple[str, ...]] = {
     # run lifecycle
     "run_header": ("engine", "visited_impl", "config_sig"),
@@ -59,10 +70,15 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # dedup / fpset (deltas since the previous flush record)
     "flush": ("flushes", "probe_rounds", "failures", "valid_lanes"),
     "fpset_insert": ("inserts", "probe_rounds", "n"),
-    # survivability
-    "ckpt_frame": ("frame_seq", "bytes", "write_s", "distinct_states"),
+    # survivability (r9: ``retries`` is the frame writer's
+    # transient-failure retry count — the ckpt_retries breadcrumb)
+    "ckpt_frame": (
+        "frame_seq", "bytes", "write_s", "retries", "distinct_states",
+    ),
     "hbm_recovery": ("recovery_n",),
     "fault": ("kind", "site", "count"),
+    # liveness edge-sweep progress (r9): one record per sweep chunk
+    "sweep": ("chunk", "chunks", "swept", "edges"),
     # legacy differential stage timings (PTT_STAGE_TIMING runs)
     "stage_timing": ("stages",),
 }

@@ -59,6 +59,17 @@ from jax import lax
 from pulsar_tlaplus_tpu.ops import dedup
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, _fmix
 
+# Width of the zero-sync device metrics vector engines accumulate next
+# to the table and ride on their ONE hot-path stats fetch: [flushes,
+# probe_rounds, failures, valid_lanes, max_probe_rounds].  valid_lanes
+# is the candidate count after validity masking (the duplicate-rate
+# denominator the host cannot know without a sync); max_probe_rounds is
+# the worst flush's probe depth (a running max, not a sum) — together
+# the probe-schedule tuning signal for DENSE_ROUNDS/STAGES below.
+# Shared by device_bfs and sharded_device (r9: the sharded fpm widened
+# 3 -> 5 to match); pre-widening checkpoint frames restore zero-padded.
+FPM_N = 5
+
 MAX_PROBES = 64
 # staged-compaction schedule for the engine hot path: a few dense
 # rounds, then (shrink divisor, probe-round limit) per stage.  At load

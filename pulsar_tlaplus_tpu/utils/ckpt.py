@@ -27,6 +27,14 @@ Design rules every engine follows:
   table, so full-column snapshots carry mostly SENTINEL runs.  The
   compacted codec stores only the occupied slots (keys + slot index)
   — frame size scales with the *state count*, not the table tier.
+- **Hardened writer**: a transient ``OSError`` (disk full, EIO, an
+  NFS hiccup) retries with bounded exponential backoff instead of
+  killing an hours-long run over one bad write; the retry count comes
+  back to the caller (the ``ckpt_retries`` telemetry breadcrumb).
+  Stale ``<path>.tmp.npz`` left by a crash mid-write is removed at
+  run start (:func:`cleanup_stale_tmp`) — the atomic ``os.replace``
+  already guarantees it never shadows a valid frame, but a dead
+  multi-GB temp file must not squat the checkpoint volume either.
 """
 
 from __future__ import annotations
@@ -39,6 +47,8 @@ import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
+
+from pulsar_tlaplus_tpu.utils import faults
 
 # v1: full-column fpset snapshots, no version field (round-4/6 sharded
 # frames).  v2: ``__format__`` field + compacted-occupancy fpset codec
@@ -54,21 +64,36 @@ def config_sig(**fields) -> str:
     return repr(tuple(sorted((k, repr(v)) for k, v in fields.items())))
 
 
+# bounded retry-with-backoff for transient frame-write failures: a
+# week-long run must not die because one write hit a full/flaky disk.
+# MAX_WRITE_RETRIES retries (so MAX+1 attempts) with exponential
+# backoff starting at WRITE_BACKOFF_S; a persistent error still raises.
+MAX_WRITE_RETRIES = 3
+WRITE_BACKOFF_S = 0.05
+
+
 def save_frame(
     path: str, sig: str, arrays: Dict[str, np.ndarray],
     wall_s: float = 0.0,
     meta: Optional[Dict[str, object]] = None,
-) -> Tuple[int, float]:
+) -> Tuple[int, float, int]:
     """Write one checkpoint frame atomically; returns ``(nbytes,
-    write_s)`` — size plus the frame-write stall time the caller was
-    blocked here (the ``ckpt_write_s`` telemetry counter: compression +
-    fsync-adjacent filesystem time, NOT the D2H gather, which engines
-    time on their side).  ``sig`` is the writer's config signature
-    (verified by :func:`load_frame`); ``wall_s`` the cumulative run
-    wall time so a resumed run's states/sec stays meaningful end to
-    end.  ``meta`` is an optional small JSON-able dict (writer run_id,
-    frame_seq, level) stored under ``__meta__`` — read back with
-    :func:`frame_meta`; v2 frames without it still load."""
+    write_s, retries)`` — size, the frame-write stall time the caller
+    was blocked here (the ``ckpt_write_s`` telemetry counter:
+    compression + fsync-adjacent filesystem time, NOT the D2H gather,
+    which engines time on their side), and how many transient-failure
+    retries the write needed (0 on the happy path; the ``ckpt_retries``
+    breadcrumb).  ``sig`` is the writer's config signature (verified by
+    :func:`load_frame`); ``wall_s`` the cumulative run wall time so a
+    resumed run's states/sec stays meaningful end to end.  ``meta`` is
+    an optional small JSON-able dict (writer run_id, frame_seq, level)
+    stored under ``__meta__`` — read back with :func:`frame_meta`; v2
+    frames without it still load.
+
+    Transient ``OSError`` (disk full, EIO) retries with bounded
+    exponential backoff; only a persistent failure propagates.  The
+    ``PTT_FAULT=ckpt_fail@frame:N`` injection raises a synthetic
+    ENOSPC on frame N's first attempt, exercising exactly this path."""
     t0 = time.perf_counter()
     tmp = path + ".tmp.npz"
     extra = {}
@@ -76,17 +101,59 @@ def save_frame(
         extra["__meta__"] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8
         )
-    np.savez_compressed(
-        tmp,
-        __format__=np.int64(FORMAT_VERSION),
-        sig=np.frombuffer(sig.encode(), dtype=np.uint8),
-        wall_s=np.float64(wall_s),
-        **extra,
-        **arrays,
+    inject = meta is not None and meta.get(
+        "frame_seq"
+    ) is not None and "ckpt_fail" in faults.poll(
+        "frame", int(meta["frame_seq"])
     )
-    nbytes = os.path.getsize(tmp)
-    os.replace(tmp, path)  # atomic vs crashes and concurrent readers
-    return nbytes, time.perf_counter() - t0
+    retries = 0
+    while True:
+        try:
+            if inject:
+                inject = False  # transient: only the first attempt
+                raise OSError(
+                    28,
+                    "No space left on device "
+                    "(injected fault ckpt_fail, PTT_FAULT)",
+                )
+            np.savez_compressed(
+                tmp,
+                __format__=np.int64(FORMAT_VERSION),
+                sig=np.frombuffer(sig.encode(), dtype=np.uint8),
+                wall_s=np.float64(wall_s),
+                **extra,
+                **arrays,
+            )
+            nbytes = os.path.getsize(tmp)
+            os.replace(tmp, path)  # atomic vs crashes + readers
+            return nbytes, time.perf_counter() - t0, retries
+        except OSError:
+            # a half-written tmp from the failed attempt must not
+            # linger (and on ENOSPC, freeing it is what lets the
+            # retry succeed)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            if retries >= MAX_WRITE_RETRIES:
+                raise
+            time.sleep(WRITE_BACKOFF_S * (1 << retries))
+            retries += 1
+
+
+def cleanup_stale_tmp(path: Optional[str]) -> bool:
+    """Remove a stale ``<path>.tmp.npz`` left by a crash mid-write
+    (engines call this at run start).  The atomic ``os.replace``
+    already guarantees a tmp never shadows a valid frame; this is
+    disk hygiene — a dead multi-GB temp must not squat the checkpoint
+    volume.  Returns True when something was removed."""
+    if not path:
+        return False
+    try:
+        os.remove(path + ".tmp.npz")
+        return True
+    except OSError:
+        return False
 
 
 def frame_meta(d) -> Dict[str, object]:

@@ -7,15 +7,20 @@ stage on flush 3".  This module turns the ``PTT_FAULT`` environment
 variable into synthetic faults fired at named host-side sites:
 
     PTT_FAULT=oom@level:7              synthetic RESOURCE_EXHAUSTED
+    PTT_FAULT=oom@flush:3              same, at the flush site (hits the
+                                       sharded fpset flush too)
     PTT_FAULT=fpset_fail@flush:3       fpset stage-overflow (fail-stop)
     PTT_FAULT=kill@level:5             hard process death (os._exit 137)
     PTT_FAULT=sigterm@level:4          SIGTERM to self (preemption drill)
+    PTT_FAULT=ckpt_fail@frame:1        transient OSError on checkpoint
+                                       frame 1's write (retry drill)
     PTT_FAULT=oom@level:7,kill@level:9 comma-separated specs compose
 
 Syntax: ``kind@site:count`` — ``site`` is a counter the engines
 advance (``level`` = the BFS level about to be expanded, ``flush`` =
-the flush sequence number), ``count`` the value at which the spec
-fires.  Each spec fires AT MOST ONCE per process: a run that recovers
+the flush sequence number, ``frame`` = the checkpoint frame sequence
+number, ``sweep`` = the liveness engine's edge-sweep chunk), ``count``
+the value at which the spec fires.  Each spec fires AT MOST ONCE per process: a run that recovers
 from an injected OOM and re-expands the same level must not be
 re-injected forever (mirroring the real world, where the recovery's
 degraded capacity is what prevents the repeat).
@@ -54,7 +59,7 @@ class FaultError(RuntimeError):
     engines' real out-of-memory handlers fire."""
 
 
-KINDS = ("oom", "fpset_fail", "kill", "sigterm")
+KINDS = ("oom", "fpset_fail", "kill", "sigterm", "ckpt_fail")
 
 # parse cache keyed on the raw env value + set of fired spec indexes
 # (per process; a changed PTT_FAULT re-arms everything)

@@ -1,0 +1,114 @@
+"""Shared HBM-exhaustion recovery layer (round 9).
+
+Round 7 gave the single-chip engine the free-buffers → rebuild-from-
+the-last-frame → continue-at-degraded-capacity state machine; this
+module is that machinery factored out so the mesh-sharded engine (and
+anything that checkpoints through ``utils/ckpt.py``) runs the SAME
+contract instead of fail-stopping on the first ``RESOURCE_EXHAUSTED``:
+
+::
+
+               RESOURCE_EXHAUSTED
+      RUNNING ────────────────────────► frame on disk, armed?
+         ▲                                   │yes           │no
+         │  rebuild from frame at            ▼              ▼
+         │  DEGRADED capacity:          RECOVERING     truncate honestly
+         │  - group-ahead halved             │          (stop_reason="hbm")
+         │  - growth headroom frozen         │
+         └───────────────────────────────────┘
+
+The pieces:
+
+- :func:`is_resource_exhausted` — the ONE place that decides whether
+  an exception is an allocator failure (real XLA OOM or the injected
+  ``PTT_FAULT=oom@...`` drill, which embeds the same status text so it
+  exercises the same handler).
+- :class:`HbmExhausted` — internal control flow raised by a level loop
+  when exhaustion hits while a valid frame exists.  The rebuild happens
+  OUTSIDE the ``except`` block that catches it: the traceback pins the
+  loop's frame locals (accumulators, expand windows) plus the chained
+  XLA error, and restoring under it would re-OOM exactly when memory
+  is tightest.
+- :class:`RecoveryState` — the armed/recovered/degraded bookkeeping
+  both engines share.  "Armed" means the on-disk frame is valid AND no
+  recovery has consumed it since; a second exhaustion without a fresh
+  frame in between means recovery is not making progress — truncate
+  honestly rather than loop.  Degradation halves the dispatch
+  group-ahead (fewer in-flight flushes = smaller worst-case
+  transients) and freezes growth headroom to one accumulator, so the
+  retry fits where the full-headroom run did not.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """True for XLA allocator failures (and the ``PTT_FAULT`` oom
+    drill, whose message embeds the same status prefix on purpose)."""
+    return "RESOURCE_EXHAUSTED" in str(e)
+
+
+class HbmExhausted(Exception):
+    """Internal control flow: a RESOURCE_EXHAUSTED surfaced while a
+    valid checkpoint frame exists — the run loop rebuilds device state
+    from that frame at degraded capacity instead of truncating.
+
+    ``nv`` and ``level_sizes`` snapshot what the interrupted attempt
+    had verified (reported honestly if the rebuild itself fails)."""
+
+    def __init__(self, nv: int, level_sizes: List[int], msg: str):
+        super().__init__(msg)
+        self.nv = nv
+        self.level_sizes = level_sizes
+        self.msg = msg
+
+
+class RecoveryState:
+    """Armed/recovered/degraded bookkeeping for one checker instance.
+
+    ``group0`` is the pre-degradation dispatch group-ahead; ``group``
+    the current (possibly halved) one.  ``headroom_frozen`` tells the
+    engine's growth logic to reserve one accumulator of headroom
+    instead of a full group's worth.
+    """
+
+    def __init__(self, checkpoint_path: Optional[str], group: int):
+        self.checkpoint_path = checkpoint_path
+        self.group0 = group
+        self.group = group
+        self.hbm_recovered = 0
+        self.armed = False
+        self.headroom_frozen = False
+
+    def reset(self) -> None:
+        """Per-run reset: a fresh run() must not inherit a previous
+        run's degraded capacity or recovery counts."""
+        self.group = self.group0
+        self.hbm_recovered = 0
+        self.armed = False
+        self.headroom_frozen = False
+
+    def arm(self) -> None:
+        """A fresh resumable frame reached disk (or a resume started
+        from one): the next exhaustion may rebuild from it."""
+        self.armed = True
+
+    def can_recover(self) -> bool:
+        return (
+            self.armed
+            and self.checkpoint_path is not None
+            and os.path.exists(self.checkpoint_path)
+        )
+
+    def degrade(self) -> int:
+        """Consume the armed frame and degrade capacity for the retry:
+        count the recovery, halve the group-ahead, freeze growth
+        headroom.  Returns the new group-ahead."""
+        self.hbm_recovered += 1
+        self.armed = False
+        self.group = max(1, self.group // 2)
+        self.headroom_frozen = True
+        return self.group

@@ -11,13 +11,18 @@ File kind is sniffed by extension: ``.jsonl`` = event stream, ``.json``
 = bench artifact (the driver wrapper ``{"parsed": {...}}`` and the raw
 bench line both work).
 
-Stream rules (schema v1, ``obs/telemetry.py`` EVENTS is authoritative):
+Stream rules (schema v2, ``obs/telemetry.py`` EVENTS is authoritative;
+older records are held only to their own version's fields):
 every line parses as an object; carries ``v``/``event``/``t``/
 ``run_id``; ``v`` <= the supported version; ``t`` is monotonically
 non-decreasing per run_id; known event types carry their required
-fields.  Bench rules: ``bench_schema`` >= 2 requires the headline keys,
->= 3 additionally the telemetry/survivability key set (``fpset_*``,
-``ckpt_*``, ``stop_reason``...).
+fields (r9 additions: ``ckpt_frame`` carries the frame writer's
+``retries`` count, the liveness engine emits per-chunk ``sweep``
+records, and the sharded engine's ``flush`` records carry the 5-wide
+fpm keys — real ``valid_lanes`` + ``max_probe_rounds``).  Bench rules:
+``bench_schema`` >= 2 requires the headline keys, >= 3 additionally
+the telemetry/survivability key set (``fpset_*``, ``ckpt_*``,
+``stop_reason``...), >= 4 additionally ``ckpt_retries``.
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -38,6 +43,7 @@ sys.path.insert(
 from pulsar_tlaplus_tpu.obs.telemetry import (  # noqa: E402
     BASE_FIELDS,
     EVENTS,
+    FIELD_SINCE,
     SCHEMA_VERSION,
 )
 
@@ -54,6 +60,8 @@ BENCH_KEYS_V3 = BENCH_KEYS_V2 + (
     "fpset_valid_lanes", "fpset_max_probe_rounds",
     "visited_impl", "max_states", "stats_fetches",
 )
+# v4 (r9): the frame writer's transient-failure retry breadcrumb
+BENCH_KEYS_V4 = BENCH_KEYS_V3 + ("ckpt_retries",)
 
 
 def validate_stream(path: str) -> List[str]:
@@ -104,7 +112,15 @@ def validate_stream(path: str) -> List[str]:
                 last_t[rid] = rec["t"]
             req = EVENTS.get(rec["event"])
             if req:
-                miss = [k for k in req if k not in rec]
+                # a record is held only to the fields its OWN schema
+                # version requires — pre-r9 (v1) streams stay valid
+                # even though v2 added fields (FIELD_SINCE)
+                v = rec["v"] if isinstance(rec["v"], int) else 1
+                miss = [
+                    k for k in req
+                    if k not in rec
+                    and FIELD_SINCE.get((rec["event"], k), 1) <= v
+                ]
                 if miss:
                     errors.append(
                         f"{path}:{i}: {rec['event']} missing {miss}"
@@ -140,7 +156,12 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    required = BENCH_KEYS_V3 if schema >= 3 else BENCH_KEYS_V2
+    if schema >= 4:
+        required = BENCH_KEYS_V4
+    elif schema >= 3:
+        required = BENCH_KEYS_V3
+    else:
+        required = BENCH_KEYS_V2
     for k in required:
         if k not in d:
             errors.append(

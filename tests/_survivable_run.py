@@ -19,7 +19,7 @@ import sys
 def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     ap = argparse.ArgumentParser()
-    ap.add_argument("--engine", choices=["device", "sharded"],
+    ap.add_argument("--engine", choices=["device", "sharded", "liveness"],
                     default="device")
     ap.add_argument("--checkpoint", required=True)
     ap.add_argument("--resume", action="store_true")
@@ -28,6 +28,16 @@ def main():
     ap.add_argument("--max-states", type=int, default=200_000_000)
     ap.add_argument("--telemetry", default=None)
     ap.add_argument("--progress", type=float, default=None)
+    ap.add_argument("--goal", default="Termination")
+    ap.add_argument("--fairness", default="wf_next")
+    ap.add_argument("--sweep-chunk", type=int, default=1 << 12)
+    ap.add_argument("--frontier-chunk", type=int, default=2048)
+    ap.add_argument(
+        "--config", default="shipped",
+        choices=["shipped", "producer_on", "consumer_on"],
+        help="shipped = the published 45k oracle; producer_on / "
+        "consumer_on = the small liveness oracles (no-lasso / lasso)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -37,8 +47,46 @@ def main():
     from pulsar_tlaplus_tpu.models.compaction import CompactionModel
     from pulsar_tlaplus_tpu.ref import pyeval as pe
 
-    m = CompactionModel(pe.SHIPPED_CFG)
+    if args.config == "shipped":
+        c = pe.SHIPPED_CFG
+    else:
+        import dataclasses
+
+        from tests.helpers import SMALL_CONFIGS
+
+        c = SMALL_CONFIGS["producer_on"]
+        if args.config == "consumer_on":
+            c = dataclasses.replace(c, model_consumer=True)
+    m = CompactionModel(c)
     inv = (args.invariant,) if args.invariant else ()
+    if args.engine == "liveness":
+        from pulsar_tlaplus_tpu.engine.liveness import LivenessChecker
+
+        lck = LivenessChecker(
+            m, goal=args.goal, fairness=args.fairness,
+            frontier_chunk=args.frontier_chunk,
+            sweep_chunk=args.sweep_chunk,
+            visited_cap=1 << 13,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.every,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
+        )
+        lr = lck.run(resume=args.resume)
+        print(
+            json.dumps(
+                {
+                    "holds": lr.holds,
+                    "reason": lr.reason,
+                    "distinct_states": lr.distinct_states,
+                    "truncated": lr.truncated,
+                    "stop_reason": lr.stop_reason,
+                    "lasso_prefix": lr.lasso_prefix,
+                    "lasso_cycle": lr.lasso_cycle,
+                }
+            )
+        )
+        return 0
     if args.engine == "device":
         from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
 

@@ -135,15 +135,15 @@ def test_frame_meta_roundtrip(tmp_path):
     p = str(tmp_path / "f.npz")
     import numpy as np
 
-    nbytes, write_s = ckpt.save_frame(
+    nbytes, write_s, retries = ckpt.save_frame(
         p, "sig", {"a": np.arange(3)},
         meta={"run_id": "abc", "frame_seq": 7},
     )
-    assert nbytes > 0 and write_s >= 0.0
+    assert nbytes > 0 and write_s >= 0.0 and retries == 0
     d = ckpt.load_frame(p, "sig")
     assert ckpt.frame_meta(d) == {"run_id": "abc", "frame_seq": 7}
     # frames without meta read back as {}
-    nbytes, _ = ckpt.save_frame(p, "sig", {"a": np.arange(3)})
+    nbytes, _, _ = ckpt.save_frame(p, "sig", {"a": np.arange(3)})
     assert ckpt.frame_meta(ckpt.load_frame(p, "sig")) == {}
 
 
