@@ -146,18 +146,37 @@ def measure_python_baseline(c, budget_s: float):
     return len(seen) / max(time.time() - t0, 1e-9), levels
 
 
-def sustained_rates(metrics_path, wall_s):
-    """(last_level_sps, final_60s_sps or None) from the per-level
-    JSONL: the last level's incremental rate is the deep-regime
-    sustained figure (VERDICT r3 #3); the final-60s window exists only
-    when the run lasts that long."""
-    recs = []
-    try:
-        with open(metrics_path) as f:
-            for line in f:
-                recs.append(json.loads(line))
-    except OSError:
-        return None, None
+def telemetry_level_records(events):
+    """(wall_s, distinct_states) progress records of the LAST run among
+    parsed telemetry ``events`` — the round-10 source of truth for the
+    sustained rates (the stream exists on every bench run now that
+    --telemetry defaults on; the per-level metrics JSONL remains the
+    fallback)."""
+    runs = [e.get("run_id") for e in events if e.get("event") == "level"]
+    if not runs:
+        return []
+    last_run = runs[-1]
+    return [
+        {
+            "wall_s": e["wall_s"],
+            "distinct_states": e["distinct_states"],
+        }
+        for e in events
+        if e.get("event") == "level"
+        and e.get("run_id") == last_run
+        and "wall_s" in e
+        and "distinct_states" in e
+    ]
+
+
+def sustained_rates(recs, wall_s):
+    """(last_level_sps, final_60s_sps or None) from progress records
+    (telemetry ``level`` events, or the legacy per-level metrics
+    JSONL): the last level's incremental rate is the deep-regime
+    sustained figure (VERDICT r3 #3); the final-60s figure is measured
+    over a GENUINE trailing >= 60 s window anchored in the records —
+    a 60-70 s run whose records cannot span one reports None instead
+    of relabeling the whole run (VERDICT r5 weak #2)."""
     if len(recs) < 2:
         return None, None
     # trailing records can repeat the final state count (e.g. the
@@ -193,11 +212,24 @@ def sustained_rates(metrics_path, wall_s):
             final60 = (
                 last["distinct_states"] - base["distinct_states"]
             ) / (last["wall_s"] - base["wall_s"])
-        elif last["wall_s"] >= 60.0:
-            # a 60-70 s run whose earliest record lands after the cut:
-            # the whole run [0, wall] IS a >= 60 s window
-            final60 = last["distinct_states"] / last["wall_s"]
+        # no >= 60 s record window -> None.  (The pre-r10 fallback
+        # counted a whole 60-70 s run as "the final 60 s", which
+        # relabeled the warm-up-inclusive average as a sustained
+        # figure — VERDICT r5 weak #2.)
     return last_level, final60
+
+
+def load_metrics_records(metrics_path):
+    """Legacy per-level metrics JSONL -> progress records (fallback
+    when no telemetry stream exists)."""
+    recs = []
+    try:
+        with open(metrics_path) as f:
+            for line in f:
+                recs.append(json.loads(line))
+    except OSError:
+        return []
+    return recs
 
 
 def parse_args(argv=None):
@@ -221,6 +253,13 @@ def parse_args(argv=None):
         "differential timing)",
     )
     ap.add_argument(
+        "--compact", choices=["logshift", "sort"], default="logshift",
+        help="stream-compaction implementation on the append hot path: "
+        "logshift (sort-free prefix-sum + doubling shifts, default) "
+        "or sort (the round-4 chunked single-key sorts, kept for "
+        "differential timing)",
+    )
+    ap.add_argument(
         "--checkpoint", default=None,
         help="write level-boundary checkpoint frames to this .npz "
         "(survivable bench runs: SIGTERM/SIGINT exit resumably, HBM "
@@ -237,10 +276,20 @@ def parse_args(argv=None):
         "starting fresh (skips the host seed)",
     )
     ap.add_argument(
-        "--telemetry", default=None, metavar="FILE",
+        "--telemetry",
+        default=f"/tmp/bench_telemetry_{os.getpid()}.jsonl",
+        metavar="FILE",
         help="write the structured run-event JSONL stream here "
-        "(docs/observability.md); scripts/telemetry_report.py turns "
-        "it into the BASELINE per-stage table and the BENCH keys",
+        "(docs/observability.md; DEFAULT ON since round 10 — the "
+        "artifact's per-stage/fpset/ckpt keys are derived from this "
+        "stream via the scripts/telemetry_report.py --bench-keys "
+        "layer; the default path is per-process so concurrent "
+        "benches never share a stream file); --no-telemetry disables",
+    )
+    ap.add_argument(
+        "--no-telemetry", dest="telemetry",
+        action="store_const", const=None,
+        help="disable the telemetry stream",
     )
     ap.add_argument(
         "--progress-every", type=float, default=None, metavar="SEC",
@@ -282,6 +331,16 @@ def main(argv=None):
         os.remove(metrics_path)
     except OSError:
         pass
+    # a USER-supplied telemetry stream is never wiped: it appends, and
+    # resume chains link headers to prior frames (docs/observability.md
+    # "Resume linking").  The per-process DEFAULT path gets the same
+    # treatment as the metrics JSONL above — PID reuse must not append
+    # this run onto a dead run's stream.
+    if args.telemetry == f"/tmp/bench_telemetry_{os.getpid()}.jsonl":
+        try:
+            os.remove(args.telemetry)
+        except OSError:
+            pass
     # Tier sizing: pre-size every capacity so no growth of the visited
     # sort tier (= no re-jit of the big flush sort) happens inside the
     # timed budget; the run is HBM-capacity-bound, not time-bound.
@@ -308,6 +367,7 @@ def main(argv=None):
         progress=True,
         metrics_path=metrics_path,
         visited_impl=args.visited,
+        compact_impl=args.compact,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         telemetry=args.telemetry,
@@ -398,8 +458,47 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
     nat_sps = nat["states_per_sec"]
     nat8_sps = nat8["states_per_sec"]
     nat8_extrap = 8.0 * nat_sps  # see module docstring
-    last_level_sps, final60_sps = sustained_rates(metrics_path, r.wall_s)
+    # one stream parse feeds both the sustained-rate records and the
+    # artifact keys; a stream file shared with other processes (a
+    # non-default --telemetry path) may interleave their runs, so the
+    # events are held to THIS run's run_id before any aggregation
+    tel_events = []
+    if args.telemetry:
+        from pulsar_tlaplus_tpu.obs import report
+
+        try:
+            tel_events, _errs = report.load_events(args.telemetry)
+        except OSError:
+            tel_events = []
+        rid = getattr(ck, "_run_id", None)
+        if rid:
+            tel_events = [
+                e for e in tel_events if e.get("run_id") == rid
+            ]
+    # sustained rates anchor in the telemetry level records (default on
+    # since round 10; a genuine trailing >= 60 s window or None), with
+    # the legacy per-level metrics JSONL as the fallback source
+    recs = telemetry_level_records(tel_events) or load_metrics_records(
+        metrics_path
+    )
+    last_level_sps, final60_sps = sustained_rates(recs, r.wall_s)
     host_wait = getattr(ck, "_host_wait_s", None)
+    # the artifact's per-stage / fpset / ckpt keys come from the
+    # telemetry stream through the SAME aggregation layer as
+    # `scripts/telemetry_report.py --bench-keys` (ROADMAP round-8 ask:
+    # no hand-copied numbers), falling back to the engine's last_stats
+    # when the stream is disabled
+    tel_keys, tel_stages = {}, None
+    if tel_events:
+        tel_keys = report.bench_keys(tel_events)
+        split = report.stage_split(tel_events)
+        if split:
+            tel_stages = {
+                name: d["n"] for name, d in sorted(split.items())
+            }
+
+    def stat(k, default=None):
+        return tel_keys.get(k, ck.last_stats.get(k, default))
     print(
         json.dumps(
             {
@@ -415,9 +514,11 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # native baseline (schema 2); schema 3 adds the
                 # telemetry/survivability key set (fpset_*, ckpt_*,
                 # stop_reason...); schema 4 adds ckpt_retries (the
-                # frame writer's transient-failure retry breadcrumb)
+                # frame writer's transient-failure retry breadcrumb);
+                # schema 5 (r10) adds compact_impl and sources the
+                # telemetry-derived keys from the stream itself
                 # — validated by scripts/check_telemetry_schema.py
-                "bench_schema": 4,
+                "bench_schema": 5,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2
@@ -451,18 +552,18 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "stop_reason": r.stop_reason,
                 "truncated": r.truncated,
                 "hbm_recovered": getattr(r, "hbm_recovered", 0),
-                "ckpt_frames": ck.last_stats.get("ckpt_frames", 0),
-                "ckpt_bytes": ck.last_stats.get("ckpt_bytes", 0),
+                "ckpt_frames": stat("ckpt_frames", 0),
+                "ckpt_bytes": stat("ckpt_bytes", 0),
                 # frame-write stall seconds (BENCH_r07 ask): host time
                 # the run loop spent blocked gathering + writing frames
-                "ckpt_write_s": ck.last_stats.get("ckpt_write_s", 0.0),
+                "ckpt_write_s": stat("ckpt_write_s", 0.0),
                 # transient frame-write failures absorbed by the
                 # retry/backoff path (nonzero = the disk hiccuped and
                 # the run survived it; docs/robustness.md)
-                "ckpt_retries": ck.last_stats.get("ckpt_retries", 0),
+                "ckpt_retries": stat("ckpt_retries", 0),
                 "checkpoint": args.checkpoint,
                 "telemetry": args.telemetry,
-                "stats_fetches": ck.last_stats.get("stats_fetches"),
+                "stats_fetches": stat("stats_fetches"),
                 "sustained_last_level_sps": (
                     round(last_level_sps, 1)
                     if last_level_sps is not None else None
@@ -476,30 +577,27 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 ),
                 "fp_collision_prob": r.fp_collision_prob,
                 "visited_impl": args.visited,
+                # stream-compaction impl on the append hot path (r10:
+                # logshift default; sort kept for differential timing)
+                "compact_impl": args.compact,
+                # per-stage dispatch counts straight from the stream
+                # (the telemetry_report --bench-keys layer; None when
+                # --no-telemetry)
+                "stages": tel_stages,
                 "max_states": args.max_states,
                 # per-flush fpset metrics (ISSUE r6 acceptance): flush
                 # count, cumulative + average probe rounds, failures
                 # (nonzero aborts the run), final table occupancy
-                "fpset_flushes": ck.last_stats.get("fpset_flushes"),
-                "fpset_probe_rounds": ck.last_stats.get(
-                    "fpset_probe_rounds"
-                ),
-                "fpset_avg_probe_rounds": ck.last_stats.get(
-                    "fpset_avg_probe_rounds"
-                ),
-                "fpset_failures": ck.last_stats.get("fpset_failures"),
-                "fpset_occupancy": ck.last_stats.get("fpset_occupancy"),
+                "fpset_flushes": stat("fpset_flushes"),
+                "fpset_probe_rounds": stat("fpset_probe_rounds"),
+                "fpset_avg_probe_rounds": stat("fpset_avg_probe_rounds"),
+                "fpset_failures": stat("fpset_failures"),
+                "fpset_occupancy": stat("fpset_occupancy"),
                 # zero-sync device counters (r8): candidate lanes after
                 # validity masking, duplicate ratio, worst flush depth
-                "fpset_valid_lanes": ck.last_stats.get(
-                    "fpset_valid_lanes"
-                ),
-                "fpset_duplicate_ratio": ck.last_stats.get(
-                    "fpset_duplicate_ratio"
-                ),
-                "fpset_max_probe_rounds": ck.last_stats.get(
-                    "fpset_max_probe_rounds"
-                ),
+                "fpset_valid_lanes": stat("fpset_valid_lanes"),
+                "fpset_duplicate_ratio": stat("fpset_duplicate_ratio"),
+                "fpset_max_probe_rounds": stat("fpset_max_probe_rounds"),
                 "engine": (
                     "device_bfs r6 (fpset HBM hash-table visited set — "
                     "no visited-width flush sort; frontier-window row "

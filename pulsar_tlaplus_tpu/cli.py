@@ -157,6 +157,7 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         progress=True,
         metrics_path=args.metrics,
         visited_impl=args.visited,
+        compact_impl=args.compact,
         telemetry=args.telemetry,
         heartbeat_s=args.progress,
         xprof_dir=args.xprof,
@@ -296,6 +297,8 @@ def _check_properties(args, model, properties, rc):
                     # takes over the checkpoint file (TLC-style: one
                     # states location per invocation)
                     checkpoint_path=args.checkpoint,
+                    sweep_group=args.sweep_group,
+                    compact_impl=args.compact,
                     telemetry=args.telemetry,
                     heartbeat_s=args.progress,
                     progress=True,
@@ -361,6 +364,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
                 frontier_chunk=args.chunk,
                 max_states=args.maxstates,
                 checkpoint_path=args.checkpoint,
+                sweep_group=args.sweep_group,
+                compact_impl=args.compact,
                 telemetry=args.telemetry,
                 heartbeat_s=args.progress,
                 progress=True,
@@ -417,6 +422,7 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             checkpoint_path=args.checkpoint,
             n_slices=args.slices,
             visited_impl=args.visited,
+            compact_impl=args.compact,
             telemetry=args.telemetry,
             heartbeat_s=args.progress,
         )
@@ -466,6 +472,7 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             progress=True,
             metrics_path=args.metrics,
             visited_impl=args.visited,
+            compact_impl=args.compact,
             checkpoint_path=args.checkpoint,
             telemetry=args.telemetry,
             heartbeat_s=args.progress,
@@ -564,6 +571,25 @@ def main(argv=None):
         "hash-table FPSet, default — dedup cost independent of the "
         "visited count) or 'sort' (the legacy sort-merge flush, kept "
         "for differential testing)",
+    )
+    pc.add_argument(
+        "-compact",
+        choices=["logshift", "sort"],
+        default="logshift",
+        help="stream-compaction implementation on the device engines' "
+        "append/sweep hot paths: 'logshift' (sort-free prefix-sum + "
+        "doubling shifts, default) or 'sort' (the legacy chunked "
+        "single-key sorts, kept for differential timing)",
+    )
+    pc.add_argument(
+        "-sweep-group",
+        dest="sweep_group",
+        type=int,
+        default=None,
+        metavar="G",
+        help="liveness edge sweep: chunks fused per device dispatch "
+        "(default: auto from HBM headroom) — the host<->device round "
+        "trip amortizes across the group",
     )
     pc.add_argument(
         "-sharded-engine",

@@ -103,6 +103,8 @@ class LivenessChecker:
         visited_cap: int = 1 << 14,
         max_states: int = 50_000_000,
         sweep_chunk: Optional[int] = None,
+        sweep_group: Optional[int] = None,
+        compact_impl: str = "logshift",
         n_devices: int = 1,
         explorer_kw: Optional[dict] = None,
         max_run: int = 1 << 14,
@@ -134,6 +136,25 @@ class LivenessChecker:
         # the goal scan chunks by F and the sweep by SF over the same
         # SENTINEL-padded table width, so SF must be a multiple of F
         self.SF = -(-self.SF // self.F) * self.F
+        # Fused+grouped sweep (round 10, VERDICT r5 #5): one jitted
+        # program runs the whole per-chunk join pipeline (merge sort +
+        # capped log-shift gid propagation + payload sort + compaction)
+        # for G consecutive chunks via lax.scan, and the host reads
+        # back three plane transfers PER GROUP instead of three per
+        # chunk — the ~130 ms tunnel RTT amortizes across G chunks.
+        # None = auto from HBM headroom at sweep time (the scan body's
+        # join temps stay one-chunk-sized; only the compacted output
+        # accumulator scales with G, bounded at the same 2^22-lane
+        # threshold the round-5 prefetch gate used).
+        if sweep_group is not None and sweep_group < 1:
+            raise ValueError(f"sweep_group must be >= 1: {sweep_group}")
+        self.sweep_group = sweep_group
+        # stream-compaction impl for the sweep's edge compaction (and
+        # the inner explorer's append): ops/compact.py log-shift by
+        # default, "sort" for differential timing
+        from pulsar_tlaplus_tpu.ops import compact as compact_ops
+
+        self.compact_impl = compact_ops.validate_impl(compact_impl)
         # pointer-jumping cap for the sweep's equal-key gid propagation
         # (ADVICE r5): doubling shifts d = 1, 2, ..., p (p = the
         # largest power of two <= max_run) cover a fill distance of
@@ -168,6 +189,7 @@ class LivenessChecker:
         inner_kw = dict(
             checkpoint_path=checkpoint_path,
             checkpoint_every=checkpoint_every,
+            compact_impl=compact_impl,
         )
         inner_kw.update(explorer_kw or {})
         if n_devices > 1:
@@ -385,35 +407,43 @@ class LivenessChecker:
         self._jits[key] = fn
         return fn
 
-    def _sweep_jit(self, cap):
-        """(rows_flat, off, n_live, table cols) -> compacted
-        ``<Next>_vars`` edges of the SF-state window at ``off``:
-        ``(n_kept, lane_idx[NQ], dst[NQ])`` where only the first
-        ``n_kept`` entries are meaningful — invalid lanes and
-        self-loops (stutters) are dropped ON DEVICE before anything
-        crosses the tunnel (VERDICT r4 #6: the round-4 sweep streamed
-        every F*A dst lane to the host, ~157 s of the 279 s total at
-        9.4M states).  A valid lane whose key misses the table keeps
-        dst = -2 so the host still fails loudly on incomplete
-        exploration.  ``src = off + lane_idx // A`` is reconstructed
-        host-side, so exactly two int32 planes (prefix-sliced) move.
+    def _sweep_jit(self, cap, G):
+        """(rows_flat, off0, n_live, table cols) -> compacted
+        ``<Next>_vars`` edges of ``G`` consecutive SF-state windows
+        starting at ``off0``: ``(n_kept[G], lane_idx[G, NQ],
+        dst[G, NQ])`` where only each row's first ``n_kept[g]`` entries
+        are meaningful — invalid lanes and self-loops (stutters) are
+        dropped ON DEVICE before anything crosses the tunnel (VERDICT
+        r4 #6: the round-4 sweep streamed every F*A dst lane to the
+        host, ~157 s of the 279 s total at 9.4M states).  A valid lane
+        whose key misses the table keeps dst = -2 so the host still
+        fails loudly on incomplete exploration.  ``src = off +
+        lane_idx // A`` is reconstructed host-side, so exactly two
+        plane transfers (group-prefix-sliced) move per GROUP.
 
-        The join is one merged sort of (table, query keys) with the
-        table's gid as payload (table entries order before equal-key
-        queries via the payload tag bit), then a log-shift propagation
-        of the gid through equal-key runs — sort + elementwise shifts
-        only, no gathers."""
-        key = ("sweep", cap)
+        Round 10 (VERDICT r5 #5): the whole per-chunk join pipeline —
+        one merged sort of (table, query keys) with the table's gid as
+        payload (table entries order before equal-key queries via the
+        payload tag bit), the capped log-shift gid propagation through
+        equal-key runs, the payload sort back to query order, and the
+        edge compaction — is FUSED into this one jitted program and
+        batched over ``G`` chunks with ``lax.scan``, so the ~130 ms
+        tunnel RTT is paid once per group instead of per chunk.  The
+        scan body's join temps stay one-chunk-sized; only the
+        compacted output planes scale with G.  Chunks past the live
+        prefix produce zero kept lanes (their query lanes are masked
+        invalid), so a partial tail group is harmless."""
+        key = ("sweep", cap, G, self.compact_impl)
         if key in self._jits:
             return self._jits[key]
         m, layout = self.model, self.model.layout
         W, A, SF = layout.W, self.model.A, self.SF
-        from pulsar_tlaplus_tpu.ops import dedup as dedup_ops
+        from pulsar_tlaplus_tpu.ops import compact as compact_ops
 
         NQ = SF * A
         K = self.K
 
-        def step(rows_flat, off, n_live, *targs):
+        def one_chunk(rows_flat, off, n_live, targs):
             tcols, tg = targs[:K], targs[K]
             rows = lax.dynamic_slice(
                 rows_flat, (off * W,), (SF * W,)
@@ -476,17 +506,42 @@ class LivenessChecker:
             lane = jnp.arange(NQ, dtype=jnp.int32)
             src = off + lane // A
             keep = (dst != -1) & (dst != src)
-            (idxc, dstc), _ = dedup_ops.compact_by_flag(
+            (idxc, dstc), _ = compact_ops.compact_by_flag(
                 (~keep).astype(jnp.uint32),
                 (lane.astype(jnp.uint32),
                  lax.bitcast_convert_type(dst, jnp.uint32)),
+                impl=self.compact_impl, need_idx=False,
             )
             n_kept = jnp.sum(keep.astype(jnp.int32))
             return n_kept, idxc, dstc
 
+        def step(rows_flat, off0, n_live, *targs):
+            def body(carry, g):
+                out = one_chunk(
+                    rows_flat, off0 + g * SF, n_live, targs
+                )
+                return carry, out
+
+            _, (nk, idxc, dstc) = lax.scan(
+                body, 0, jnp.arange(G, dtype=jnp.int32)
+            )
+            return nk, idxc, dstc
+
         fn = jax.jit(step)
         self._jits[key] = fn
         return fn
+
+    def _sweep_group_size(self) -> int:
+        """Chunks per sweep dispatch: the ctor's ``sweep_group``, else
+        auto from HBM headroom — the scan body's join temps are
+        one-chunk-sized regardless, so the only G-scaling buffers are
+        the compacted output planes; bound them at the same 2^22-lane
+        threshold the round-5 prefetch gate used (with double-buffering
+        that is two groups ≈ 64 MB of planes), capped at 8."""
+        if self.sweep_group is not None:
+            return int(self.sweep_group)
+        NQ = self.SF * self.model.A
+        return max(1, min(8, (1 << 22) // max(NQ, 1)))
 
     # ----------------------------------------------------- edge harvest
 
@@ -506,10 +561,14 @@ class LivenessChecker:
             return self._edge_cache
         A = self.model.A
         cap = self._table_cap(n)
-        rows = self._rows_padded(cap)
-        targs = self._table_jit(cap)(rows, jnp.int32(n))
-        sweep = self._sweep_jit(cap)
         SF = self.SF
+        G = self._sweep_group_size()
+        # the last group's scan windows may run past the table cap;
+        # pad the flat rows so no dynamic_slice can clamp (the overrun
+        # chunks' lanes are masked dead and compact to zero kept)
+        rows = self._rows_padded(cap + (G - 1) * SF)
+        targs = self._table_jit(cap)(rows, jnp.int32(n))
+        sweep = self._sweep_jit(cap, G)
         starts = list(range(0, n, SF))
         src_parts, dst_parts = [], []
         out_deg = np.zeros((n,), np.int64)
@@ -522,86 +581,106 @@ class LivenessChecker:
                 f"({sum(len(p) for p in src_parts)} edges so far)"
             )
         n_edges = sum(len(p) for p in src_parts)
-        # double-buffer: dispatch chunk k+1 before materializing chunk
-        # k, so device compute overlaps the ~130 ms / 20 MB/s tunnel
-        # readback (chunks are independent).  At big sweep chunks two
+        # double-buffer: dispatch group g+1 before materializing group
+        # g, so device compute overlaps the ~130 ms / 20 MB/s tunnel
+        # readback (groups are independent).  At big sweep chunks two
         # in-flight join programs double the full-table sort + shift
         # transients — that OOMed the 29.4M-state tier at SF=2^19 —
-        # so prefetch is disabled there (the per-chunk readback is a
-        # smaller fraction of chunk time at that size anyway).
-        prefetch = SF * A <= (1 << 22)
+        # so prefetch is disabled there (the per-group readback is a
+        # smaller fraction of group time at that size anyway).
+        prefetch = G * SF * A <= (1 << 22)
+        gstarts = list(range(c0, len(starts), G))
         pending = (
-            [sweep(rows, jnp.int32(starts[c0]), jnp.int32(n), *targs)]
-            if c0 < len(starts)
+            [sweep(rows, jnp.int32(starts[gstarts[0]]), jnp.int32(n),
+                   *targs)]
+            if gstarts
             else []
         )
-        for i in range(c0, len(starts)):
-            start = starts[i]
-            # deterministic fault site: sweep chunk i+1 is about to be
-            # consumed (kill/sigterm fire inside poll; an injected oom
-            # raises — the sweep has no degraded-capacity rebuild)
-            kinds = faults.poll("sweep", i + 1)
-            if "oom" in kinds:
-                raise faults.oom_error("sweep", i + 1)
-            if not pending:  # serial mode: dispatch this chunk now
+        for gi, g0 in enumerate(gstarts):
+            if not pending:  # serial mode: dispatch this group now
                 pending.append(
-                    sweep(rows, jnp.int32(start), jnp.int32(n), *targs)
+                    sweep(rows, jnp.int32(starts[g0]), jnp.int32(n),
+                          *targs)
                 )
-            if prefetch and i + 1 < len(starts):
+            if prefetch and gi + 1 < len(gstarts):
                 pending.append(
                     sweep(
-                        rows, jnp.int32(starts[i + 1]), jnp.int32(n),
-                        *targs,
+                        rows, jnp.int32(starts[gstarts[gi + 1]]),
+                        jnp.int32(n), *targs,
                     )
                 )
-            n_kept, idxc, dstc = pending.pop(0)
-            k = int(np.asarray(n_kept))
+            nk_g, idx_g, dst_g = pending.pop(0)
+            # three transfers per GROUP: the counts, then the two
+            # edge planes sliced to the group's max kept prefix — the
+            # per-chunk tunnel RTT this loop used to pay 3x per chunk
+            # now amortizes across the G chunks of the group
+            nk_host = np.asarray(nk_g)
             self._fetch_n += 1
-            if k:
-                idx = np.asarray(idxc[:k]).astype(np.int64)
-                dst = np.asarray(dstc[:k]).view(np.int32).astype(
-                    np.int64
-                )
-                if (dst == -2).any():
-                    raise RuntimeError(
-                        "edge sweep could not resolve a successor gid: "
-                        "either BFS exploration was incomplete, or one "
-                        f"state has more than {self._run_cover} "
-                        "equal-key predecessors inside a single sweep "
-                        "chunk — shrink sweep_chunk or raise max_run "
-                        f"(currently {self.max_run})"
+            last = min(g0 + G, len(starts))
+            kmax = int(nk_host[: last - g0].max()) if last > g0 else 0
+            if kmax:
+                idx_all = np.asarray(idx_g[:, :kmax])
+                dst_all = np.asarray(dst_g[:, :kmax])
+            for i in range(g0, last):
+                start = starts[i]
+                # deterministic fault site: sweep chunk i+1 is about
+                # to be consumed (kill/sigterm fire inside poll; an
+                # injected oom raises — the sweep has no
+                # degraded-capacity rebuild)
+                kinds = faults.poll("sweep", i + 1)
+                if "oom" in kinds:
+                    raise faults.oom_error("sweep", i + 1)
+                k = int(nk_host[i - g0])
+                if k:
+                    idx = idx_all[i - g0, :k].astype(np.int64)
+                    dst = dst_all[i - g0, :k].view(np.int32).astype(
+                        np.int64
                     )
-                uu = start + idx // A
-                src_parts.append(uu)
-                dst_parts.append(dst)
-                np.add.at(out_deg, uu, 1)
-                n_edges += k
-            # progress for the heartbeat (zero extra device syncs: k
-            # was already materialized above) + the stream record
-            swept = min(start + SF, n)
-            self._snap.update(
-                distinct_states=n, level=i + 1, generated=n_edges
-            )
-            self.tel.emit(
-                "sweep",
-                chunk=i + 1,
-                chunks=len(starts),
-                swept=swept,
-                edges=n_edges,
-                wall_s=round(time.time() - self._t0, 3),
-            )
-            done = i + 1 >= len(starts)
-            preempt = (
-                self._watcher is not None and self._watcher.requested
-            )
-            if self.checkpoint_path and not done and (
-                preempt or (i + 1 - c0) % self.checkpoint_every == 0
-            ):
-                self._save_sweep_frame(
-                    n, src_parts, dst_parts, out_deg, i + 1
+                    if (dst == -2).any():
+                        raise RuntimeError(
+                            "edge sweep could not resolve a successor "
+                            "gid: either BFS exploration was "
+                            "incomplete, or one state has more than "
+                            f"{self._run_cover} equal-key predecessors "
+                            "inside a single sweep chunk — shrink "
+                            "sweep_chunk or raise max_run "
+                            f"(currently {self.max_run})"
+                        )
+                    uu = start + idx // A
+                    src_parts.append(uu)
+                    dst_parts.append(dst)
+                    np.add.at(out_deg, uu, 1)
+                    n_edges += k
+                # progress for the heartbeat (zero extra device syncs:
+                # the group planes were already materialized above) +
+                # the stream record
+                swept = min(start + SF, n)
+                self._snap.update(
+                    distinct_states=n, level=i + 1, generated=n_edges
                 )
-                if preempt:
-                    raise _Preempted(n, "sweep")
+                self.tel.emit(
+                    "sweep",
+                    chunk=i + 1,
+                    chunks=len(starts),
+                    swept=swept,
+                    edges=n_edges,
+                    group=G,
+                    wall_s=round(time.time() - self._t0, 3),
+                )
+                done = i + 1 >= len(starts)
+                preempt = (
+                    self._watcher is not None
+                    and self._watcher.requested
+                )
+                if self.checkpoint_path and not done and (
+                    preempt
+                    or (i + 1 - c0) % self.checkpoint_every == 0
+                ):
+                    self._save_sweep_frame(
+                        n, src_parts, dst_parts, out_deg, i + 1
+                    )
+                    if preempt:
+                        raise _Preempted(n, "sweep")
         src = (
             np.concatenate(src_parts) if src_parts
             else np.zeros(0, np.int64)
@@ -866,12 +945,14 @@ class LivenessChecker:
             engine="liveness",
             device=dev,
             visited_impl=self._checker.visited_impl,
+            compact_impl=self.compact_impl,
             config_sig=self._config_sig(),
             wall_unix=round(time.time(), 3),
             goal=self.goal_name,
             fairness=self.fairness,
             n_devices=self.n_devices,
             sweep_chunk=self.SF,
+            sweep_group=self._sweep_group_size(),
             resume=resume,
         )
         rm = self._resume_meta

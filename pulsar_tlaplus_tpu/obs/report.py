@@ -21,8 +21,10 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Tuple
 
-# canonical stage order for the per-stage table (matches BASELINE.md)
-STAGE_ORDER = ("expand", "flush", "append", "init", "shift")
+# canonical stage order for the per-stage table (matches BASELINE.md;
+# r10 splits the append's stream compaction into its own "compact"
+# dispatch, so the old append column reads as compact + append)
+STAGE_ORDER = ("expand", "flush", "compact", "append", "init", "shift")
 
 
 def load_events(path: str) -> Tuple[List[dict], List[str]]:
@@ -221,9 +223,13 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
     recov = [e for e in events if e.get("event") == "hbm_recovery"]
     if recov:
         out.setdefault("hbm_recovered", len(recov))
+    if "compact_impl" in stats:
+        out["compact_impl"] = stats["compact_impl"]
     hd = header(events)
     if hd is not None:
         out["engine"] = hd.get("engine")
         out["visited_impl"] = hd.get("visited_impl")
+        if "compact_impl" not in out and hd.get("compact_impl"):
+            out["compact_impl"] = hd.get("compact_impl")
         out["run_id"] = hd.get("run_id")
     return out

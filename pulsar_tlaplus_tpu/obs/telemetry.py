@@ -42,10 +42,13 @@ from typing import Callable, Dict, Optional, Tuple, Union
 
 # v1: the round-8 stream.  v2 (round 9): ``ckpt_frame`` records carry
 # the frame writer's ``retries`` count, and the liveness engine emits
-# ``sweep`` records.  Validators accept <= SCHEMA_VERSION and hold a
-# record only to the fields its OWN version requires (FIELD_SINCE) —
-# pre-r9 streams stay valid.
-SCHEMA_VERSION = 2
+# ``sweep`` records.  v3 (round 10): the device engines emit
+# ``compact`` records — per-stats-fetch deltas of the stream-compaction
+# dispatch counters (the log-shift vs sort differential signal) — and
+# their run headers carry ``compact_impl``.  Validators accept
+# <= SCHEMA_VERSION and hold a record only to the fields its OWN
+# version requires (FIELD_SINCE) — pre-r10 streams stay valid.
+SCHEMA_VERSION = 3
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -56,6 +59,8 @@ BASE_FIELDS: Tuple[str, ...] = ("v", "event", "t", "seq", "run_id")
 # version that added it.  The validator skips them for older records.
 FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("ckpt_frame", "retries"): 2,
+    ("compact", "dispatches"): 3,
+    ("compact", "impl"): 3,
 }
 EVENTS: Dict[str, Tuple[str, ...]] = {
     # run lifecycle
@@ -70,6 +75,10 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # dedup / fpset (deltas since the previous flush record)
     "flush": ("flushes", "probe_rounds", "failures", "valid_lanes"),
     "fpset_insert": ("inserts", "probe_rounds", "n"),
+    # stream compaction (r10): per-stats-fetch deltas of the compact
+    # dispatch counter, tagged with the active impl (logshift|sort);
+    # PTT_STAGE_TIMING runs add ``drain_s`` for the per-stage table
+    "compact": ("dispatches", "impl"),
     # survivability (r9: ``retries`` is the frame writer's
     # transient-failure retry count — the ckpt_retries breadcrumb)
     "ckpt_frame": (

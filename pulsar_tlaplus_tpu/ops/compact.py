@@ -1,0 +1,202 @@
+"""Sort-free stream compaction — the log-shift pass that retires the
+chunked single-key sorts on every hot path (round 10 tentpole).
+
+Every engine hot path ends with the same primitive: "move the value
+columns whose ``drop`` flag is 0 to the front, preserving original
+order" — the append (device + sharded), the fpset's staged
+pending-compaction, and the liveness sweep's edge compaction.  Since
+round 4 that primitive was ``ops/dedup.compact_by_flag``: chunked
+single-key unstable sorts with the row iota embedded in the key.  The
+sort was chosen for its COMPILE behavior (a monolithic multi-operand
+stable sort compiled 4-5x slower), but its RUN cost is still a sort —
+width-linear data movement across O(log^2 n) comparator stages, and at
+round-9 bench shapes the append stage it dominates is the largest
+stage (17.6 s of ~45 s, BASELINE.md r5 split) now that the flush sort
+is gone.
+
+The replacement is prefix-sum stream compaction: one exclusive prefix
+sum of the drop flags gives every kept element its destination, and a
+sort-free materialization moves the columns.  The materialization is
+picked for the backend's memory system at trace time:
+
+- **Accelerators (the TPU hot path): masked doubling shifts** — the
+  scan-then-shift frontier compaction of tensor-core BFS frameworks
+  (BLEST, arXiv:2512.21967).  ``log2(n)`` passes; bit b of an
+  element's remaining shift distance decides whether it rides the
+  ``2^b`` shift.  Every pass is a contiguous copy + elementwise select
+  per column — the cheapest ops on the TPU memory system (9-30 ns/elem
+  contiguous vs 17-50 ns/elem latency-bound random access, BASELINE.md
+  environment facts) — and there is no comparator network, so the
+  compile is trivial (the round-4 sort-compile blowup is gone too).
+- **The CPU backend (the virtual-mesh test/differential tier):
+  prefix-sum + branchless-binary-search gather** — XLA:CPU lowers
+  sorts AND scatters to serial per-element loops (measured here:
+  ~140 ns/elem scatter, ~480 ns/elem 3-operand sort) while its gathers
+  vectorize at ~2 ns/elem, so the shift passes' 10-19 full-array
+  sweeps lose to one ``log2(n)``-round branchless binary search over
+  the inclusive kept-count (the ``dedup.bsearch_member`` idiom) + one
+  gather per column.  Same outputs element-for-element; measured
+  2-4x faster than the sort path at the 253k-oracle shapes where the
+  shifts only break even (the CPU profile is flat — there is no
+  contiguous-vs-random asymmetry to exploit).
+
+``PTT_COMPACT_MATERIALIZE=shift|gather`` overrides the choice for
+differential measurement of the materializations themselves.
+
+Correctness sketch for the shift passes (the property test hammers
+both materializations with random masks): ``delta`` (dropped elements
+before position i) is monotone non-decreasing and increases by at most
+1 per position, so among KEPT elements the partial positions
+``i - (delta_i mod 2^b)`` are strictly increasing before every pass —
+two kept elements can never collide, and the element destined for slot
+j lands there on its final moving pass and never moves again.  Dropped
+elements never move (their remaining distance starts at 0) and slots
+vacated without replacement have their distance zeroed, so stale
+copies never travel; both are eventually overwritten inside the kept
+prefix and are DON'T-CARE beyond it — the same tail contract as the
+sort path (callers consume only the ``n_kept`` prefix; the
+differential tests pin the prefix element-for-element against the
+sort).
+
+``compact_by_flag`` below is the dispatcher: ``impl="logshift"`` (the
+default everywhere since round 10) or ``impl="sort"`` — the round-4
+chunked sort kept bit-for-bit for differential timing, mirroring the
+round-6 ``-visited sort`` pattern.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pulsar_tlaplus_tpu.ops import dedup
+
+IMPLS = ("logshift", "sort")
+
+
+def validate_impl(impl: str) -> str:
+    """The one ``compact_impl`` membership check — every ctor and the
+    dispatcher route through here so a new impl is a one-line change."""
+    if impl not in IMPLS:
+        raise ValueError(
+            f"compact_impl must be {'|'.join(IMPLS)}: {impl}"
+        )
+    return impl
+
+
+def _materialization() -> str:
+    """Trace-time materialization choice (see module docstring)."""
+    env = os.environ.get("PTT_COMPACT_MATERIALIZE")
+    if env in ("shift", "gather"):
+        return env
+    if env:
+        raise ValueError(
+            f"PTT_COMPACT_MATERIALIZE must be shift|gather: {env!r}"
+        )
+    return "gather" if jax.default_backend() == "cpu" else "shift"
+
+
+def _shifted(x: jax.Array, d: int) -> jax.Array:
+    """``x`` shifted left by ``d``: out[i] = x[i + d], zero-padded."""
+    return jnp.concatenate([x[d:], jnp.zeros((d,), x.dtype)])
+
+
+def _shift_compact(drop, vals):
+    """Masked doubling-shift materialization (the TPU path): move every
+    kept element left by its drop-prefix-sum distance, one bit of the
+    distance per pass — contiguous copies and selects only."""
+    n = drop.shape[0]
+    keep = drop == 0
+    # delta[i] = dropped elements strictly before i = how far a kept
+    # element at i must move left; exclusive prefix sum of the flags
+    drop_u = drop.astype(jnp.uint32)
+    delta = jnp.cumsum(drop_u) - drop_u
+    # remaining shift distance, travelling WITH each element.  Dropped
+    # elements get 0 so they never ride a shift (a dropped element
+    # pulled over a kept one was the classic corruption mode).
+    rem = jnp.where(keep, delta, jnp.uint32(0))
+    d = 1
+    while d < n:
+        du = jnp.uint32(d)
+        rem_s = _shifted(rem, d)
+        # pull from i+d when THAT element's remaining distance has this
+        # bit set; stale/dropped slots have rem 0 and are never pulled
+        take = (rem_s & du) != 0
+        vals = [jnp.where(take, _shifted(v, d), v) for v in vals]
+        # a slot whose occupant left with nothing arriving holds a
+        # stale copy: zero its distance so it can never move again
+        rem_keep = jnp.where((rem & du) != 0, jnp.uint32(0), rem)
+        rem = jnp.where(take, rem_s - du, rem_keep)
+        d <<= 1
+    return vals
+
+
+def _gather_compact(drop, vals):
+    """Prefix-sum + branchless-binary-search gather materialization
+    (the CPU path): ``src[j]`` = the j-th kept original index, found by
+    an unrolled binary search over the inclusive kept-count vector
+    (``dedup.bsearch_member``'s idiom), then one vectorized gather per
+    column.  Positions past the kept count gather garbage — the shared
+    tail contract."""
+    n = drop.shape[0]
+    kc = jnp.cumsum((drop == 0).astype(jnp.int32))
+    tgt = jnp.arange(1, n + 1, dtype=jnp.int32)
+    lo = jnp.zeros((n,), jnp.int32)
+    hi = jnp.full((n,), n, jnp.int32)
+    for _ in range(max(1, n.bit_length())):
+        mid = (lo + hi) >> 1
+        less = kc[mid] < tgt
+        lo = jnp.where(less, mid + 1, lo)
+        hi = jnp.where(less, hi, mid)
+    src = jnp.clip(lo, 0, n - 1)
+    return [v[src] for v in vals], src
+
+
+def logshift_compact(
+    drop: jax.Array, cols, need_idx: bool = True
+) -> Tuple[tuple, Optional[jax.Array]]:
+    """Sort-free stable compaction of ``cols`` to the front where
+    ``drop == 0`` (module docstring; materialization is
+    backend-adaptive at trace time).
+
+    Same contract as :func:`ops.dedup.compact_by_flag`: the kept prefix
+    is in original order; positions past the kept count are don't-care.
+    ``idx[j]`` is the original row of compacted position ``j`` (valid
+    in the kept prefix); pass ``need_idx=False`` to skip carrying the
+    index column when the caller discards it.
+    """
+    n = drop.shape[0]
+    vals = list(cols)
+    if _materialization() == "gather":
+        # the search's src vector IS the original-index map — idx
+        # rides for free, no extra column travels
+        out, src = _gather_compact(drop, vals)
+        return tuple(out), (src if need_idx else None)
+    if need_idx:
+        vals.append(jnp.arange(n, dtype=jnp.uint32))
+    out = _shift_compact(drop, vals)
+    idx = None
+    if need_idx:
+        idx = out[-1].astype(jnp.int32)
+        out = out[:-1]
+    return tuple(out), idx
+
+
+def compact_by_flag(
+    drop: jax.Array,
+    cols,
+    impl: str = "logshift",
+    chunk: int = 5,
+    need_idx: bool = True,
+):
+    """Dispatch stream compaction: ``"logshift"`` (default — the
+    sort-free kernel above) or ``"sort"`` (the round-4 chunked
+    single-key sorts, kept verbatim in ``ops/dedup.py`` for
+    differential timing).  Returns ``(compacted cols, idx)`` with
+    identical kept-prefix semantics either way."""
+    if validate_impl(impl) == "sort":
+        return dedup.compact_by_flag(drop, cols, chunk=chunk)
+    return logshift_compact(drop, cols, need_idx=need_idx)

@@ -50,13 +50,14 @@ per lane at ~2 and makes stage overflow astronomically unlikely.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pulsar_tlaplus_tpu.ops import dedup
+from pulsar_tlaplus_tpu.ops import compact as compact_ops
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, _fmix
 
 # Width of the zero-sync device metrics vector engines accumulate next
@@ -74,9 +75,90 @@ MAX_PROBES = 64
 # staged-compaction schedule for the engine hot path: a few dense
 # rounds, then (shrink divisor, probe-round limit) per stage.  At load
 # <= 1/2 the expected pending fraction entering stage i is ~2^-rounds,
-# well under 1/divisor (see module docstring).
+# well under 1/divisor (see module docstring).  These are first-guess
+# constants — the real-chip tuning signal is the zero-sync
+# ``fpset_max_probe_rounds``/``fpset_avg_probe_rounds`` counters
+# (docs/observability.md), and the schedule is sweepable without code
+# edits: engine/FPSet ctor params, or the ``PTT_FPSET_SCHEDULE`` env
+# override parsed by :func:`resolve_schedule` (round 10).
 DENSE_ROUNDS = 4
 STAGES = ((4, 16), (16, MAX_PROBES))
+
+
+def parse_schedule(spec: str) -> Tuple[int, Tuple[Tuple[int, int], ...]]:
+    """Parse a probe-schedule spec ``"DENSE[,DIV:LIMIT]*"`` — e.g. the
+    default is ``"4,4:16,16:64"`` (4 dense rounds, then a 1/4-width
+    stage probing to round 16 and a 1/16-width stage to round 64).
+    Raises ValueError with the offending token on malformed input."""
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    if not parts:
+        raise ValueError(f"empty fpset schedule: {spec!r}")
+    try:
+        dense = int(parts[0])
+    except ValueError:
+        raise ValueError(
+            f"fpset schedule must start with the dense round count "
+            f"(got {parts[0]!r} in {spec!r})"
+        ) from None
+    stages = []
+    for tok in parts[1:]:
+        try:
+            div_s, limit_s = tok.split(":", 1)
+            div, limit = int(div_s), int(limit_s)
+        except ValueError:
+            raise ValueError(
+                f"bad fpset schedule stage {tok!r} (want DIV:LIMIT) "
+                f"in {spec!r}"
+            ) from None
+        if div < 2 or limit < 1:
+            raise ValueError(
+                f"bad fpset schedule stage {tok!r} (DIV >= 2, "
+                f"LIMIT >= 1) in {spec!r}"
+            )
+        stages.append((div, limit))
+    if dense < 1:
+        raise ValueError(f"fpset dense rounds must be >= 1: {spec!r}")
+    return dense, tuple(stages)
+
+
+def schedule_hint(dense_rounds, stages) -> str:
+    """Remediation hint for a probe-overflow abort.  Under the default
+    schedule an overflow means the table broke its load-factor contract
+    (the capacity is the lever); under a custom schedule — notably a
+    dense-only or LIMIT-truncated sweep via ``PTT_FPSET_SCHEDULE`` —
+    the truncated probe budget is the likelier culprit, so name it
+    instead of blaming visited_cap."""
+    if (int(dense_rounds), tuple(stages)) == (DENSE_ROUNDS, STAGES):
+        return (
+            "raise visited_cap (the table broke its load-factor "
+            "contract)"
+        )
+    sched = ",".join(
+        [str(int(dense_rounds))]
+        + [f"{d}:{limit}" for d, limit in stages]
+    )
+    return (
+        f"the active probe schedule '{sched}' (ctor / "
+        "PTT_FPSET_SCHEDULE) truncates probing — raise its round "
+        "LIMITs, add a stage, or raise visited_cap"
+    )
+
+
+def resolve_schedule(
+    dense_rounds: Optional[int] = None, stages=None
+) -> Tuple[int, Tuple[Tuple[int, int], ...]]:
+    """The effective probe schedule: explicit ctor values win, then the
+    ``PTT_FPSET_SCHEDULE`` env override (so a real-chip tuning pass can
+    sweep the schedule without code edits), then the module defaults."""
+    env = os.environ.get("PTT_FPSET_SCHEDULE")
+    env_dense, env_stages = (
+        parse_schedule(env) if env else (None, None)
+    )
+    if dense_rounds is None:
+        dense_rounds = env_dense if env_dense is not None else DENSE_ROUNDS
+    if stages is None:
+        stages = env_stages if env_stages is not None else STAGES
+    return int(dense_rounds), tuple(tuple(s) for s in stages)
 # stage-capacity floor: the 1/div shrink is a concentration argument
 # that only holds for large batches (binomial tail at nq/16 expected
 # pending vs nq/4 capacity).  Small batches get the full width — for
@@ -223,8 +305,9 @@ def lookup_or_insert(
     kcols: Tuple[jax.Array, ...],
     valid: jax.Array,
     max_probes: int = MAX_PROBES,
-    dense_rounds: int = DENSE_ROUNDS,
-    stages=STAGES,
+    dense_rounds: Optional[int] = None,
+    stages=None,
+    compact_impl: str = "logshift",
 ):
     """Engine hot path: staged batched lookup-or-insert (see module
     docstring for the why of the stages).
@@ -238,6 +321,7 @@ def lookup_or_insert(
     """
     nq = kcols[0].shape[0]
     K = len(kcols)
+    dense_rounds, stages = resolve_schedule(dense_rounds, stages)
     is_new, tcols, _, pending, r = probe_insert(
         tcols, kcols, valid, max_probes=min(dense_rounds, max_probes)
     )
@@ -255,15 +339,17 @@ def lookup_or_insert(
             is_new = _merge_new(is_new, is_new2, cur_ids, nq)
             continue
         # order-preserving compaction of the pending lanes (+ their
-        # original lane ids) into the 1/div-size stage buffer
+        # original lane ids) into the 1/div-size stage buffer —
+        # log-shift by default (round 10), sort behind compact_impl
         ids = (
             cur_ids
             if cur_ids is not None
             else jnp.arange(nq, dtype=jnp.int32)
         )
         drop = (~cur_pending).astype(jnp.uint32)
-        ccols, _ = dedup.compact_by_flag(
-            drop, tuple(cur_keys) + (ids.astype(jnp.uint32),)
+        ccols, _ = compact_ops.compact_by_flag(
+            drop, tuple(cur_keys) + (ids.astype(jnp.uint32),),
+            impl=compact_impl, need_idx=False,
         )
         npend = jnp.sum(cur_pending.astype(jnp.int32))
         n_failed = n_failed + jnp.maximum(npend - capi, 0)
@@ -372,12 +458,27 @@ class FPSet:
     probe/occupancy/failure metrics.  The device engines inline the
     functional core above in their own jitted programs instead."""
 
-    def __init__(self, ncols: int, cap: int = 1 << 10, telemetry=None):
+    def __init__(
+        self,
+        ncols: int,
+        cap: int = 1 << 10,
+        telemetry=None,
+        dense_rounds: Optional[int] = None,
+        stages=None,
+        compact_impl: str = "logshift",
+    ):
         from pulsar_tlaplus_tpu.obs import telemetry as obs
 
         self.cols = empty_cols(cap, ncols)
         self.ncols = ncols
         self.n = 0
+        # probe schedule: ctor params > PTT_FPSET_SCHEDULE > defaults
+        # (the real-chip tuning pass sweeps these; the feedback signal
+        # is fpset_max_probe_rounds/avg — docs/observability.md)
+        self.dense_rounds, self.stages = resolve_schedule(
+            dense_rounds, stages
+        )
+        self.compact_impl = compact_ops.validate_impl(compact_impl)
         self.stats = {"inserts": 0, "probe_rounds": 0, "failures": 0}
         # optional JSONL stream (obs.telemetry): one ``fpset_insert``
         # record per batched insert — host-loop users get the same
@@ -418,7 +519,9 @@ class FPSet:
             valid = jnp.ones((nq,), jnp.bool_)
         self.reserve(self.n + nq)
         is_new, self.cols, n_failed, rounds = lookup_or_insert(
-            self.cols, kcols, valid
+            self.cols, kcols, valid,
+            dense_rounds=self.dense_rounds, stages=self.stages,
+            compact_impl=self.compact_impl,
         )
         nf = int(n_failed)
         from pulsar_tlaplus_tpu.utils import faults

@@ -11,7 +11,7 @@ File kind is sniffed by extension: ``.jsonl`` = event stream, ``.json``
 = bench artifact (the driver wrapper ``{"parsed": {...}}`` and the raw
 bench line both work).
 
-Stream rules (schema v2, ``obs/telemetry.py`` EVENTS is authoritative;
+Stream rules (schema v3, ``obs/telemetry.py`` EVENTS is authoritative;
 older records are held only to their own version's fields):
 every line parses as an object; carries ``v``/``event``/``t``/
 ``run_id``; ``v`` <= the supported version; ``t`` is monotonically
@@ -19,10 +19,14 @@ non-decreasing per run_id; known event types carry their required
 fields (r9 additions: ``ckpt_frame`` carries the frame writer's
 ``retries`` count, the liveness engine emits per-chunk ``sweep``
 records, and the sharded engine's ``flush`` records carry the 5-wide
-fpm keys — real ``valid_lanes`` + ``max_probe_rounds``).  Bench rules:
-``bench_schema`` >= 2 requires the headline keys, >= 3 additionally
-the telemetry/survivability key set (``fpset_*``, ``ckpt_*``,
-``stop_reason``...), >= 4 additionally ``ckpt_retries``.
+fpm keys — real ``valid_lanes`` + ``max_probe_rounds``; r10: the
+device engines emit ``compact`` records — per-fetch deltas of the
+stream-compaction dispatch counters with the active ``impl`` — held
+to their fields only at v3 via FIELD_SINCE, so pre-r10 streams stay
+validator-clean).  Bench rules: ``bench_schema`` >= 2 requires the
+headline keys, >= 3 additionally the telemetry/survivability key set
+(``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
+``ckpt_retries``, >= 5 additionally ``compact_impl``.
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -62,6 +66,8 @@ BENCH_KEYS_V3 = BENCH_KEYS_V2 + (
 )
 # v4 (r9): the frame writer's transient-failure retry breadcrumb
 BENCH_KEYS_V4 = BENCH_KEYS_V3 + ("ckpt_retries",)
+# v5 (r10): the stream-compaction impl (logshift|sort differential)
+BENCH_KEYS_V5 = BENCH_KEYS_V4 + ("compact_impl",)
 
 
 def validate_stream(path: str) -> List[str]:
@@ -156,7 +162,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 4:
+    if schema >= 5:
+        required = BENCH_KEYS_V5
+    elif schema >= 4:
         required = BENCH_KEYS_V4
     elif schema >= 3:
         required = BENCH_KEYS_V3
