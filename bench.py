@@ -35,11 +35,15 @@ Prints exactly ONE JSON line on stdout.
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
 BENCH_BUDGET_S = 150.0
 BASELINE_SLICE_S = 30.0
+# sentinel: resolved after parse to
+# <--telemetry-path>/bench_telemetry_<pid>.jsonl
+_DEFAULT_TELEMETRY = "__per_process__"
 # Round 5 broke the HBM wall with the frontier-window row store; round
 # 6 retires the flush sort, and with it the 150M cap that nulled the
 # canonical sustained-60s metric (VERDICT r5: the bench's own cap
@@ -144,6 +148,39 @@ def measure_python_baseline(c, budget_s: float):
         if not cut:
             levels += 1  # only fully expanded levels count as reached
     return len(seen) / max(time.time() - t0, 1e-9), levels
+
+
+def cleanup_stale_streams(dir_path: str) -> int:
+    """Remove ``bench_telemetry_<pid>.jsonl`` streams whose pid is no
+    longer alive (default-on telemetry otherwise leaks one file per
+    bench run forever).  A pid we cannot signal but that exists
+    (EPERM) is treated as alive; our own stream is never touched.
+    Returns the number of files removed."""
+    removed = 0
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return 0
+    for name in names:
+        m = re.fullmatch(r"bench_telemetry_(\d+)\.jsonl", name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # alive: its stream is in use
+        except ProcessLookupError:
+            pass  # dead: the stream is stale
+        except (PermissionError, OSError):
+            continue  # exists (or unknowable): leave it alone
+        try:
+            os.remove(os.path.join(dir_path, name))
+            removed += 1
+        except OSError:
+            pass
+    return removed
 
 
 def telemetry_level_records(events):
@@ -277,19 +314,27 @@ def parse_args(argv=None):
     )
     ap.add_argument(
         "--telemetry",
-        default=f"/tmp/bench_telemetry_{os.getpid()}.jsonl",
+        default=_DEFAULT_TELEMETRY,
         metavar="FILE",
         help="write the structured run-event JSONL stream here "
         "(docs/observability.md; DEFAULT ON since round 10 — the "
         "artifact's per-stage/fpset/ckpt keys are derived from this "
         "stream via the scripts/telemetry_report.py --bench-keys "
-        "layer; the default path is per-process so concurrent "
-        "benches never share a stream file); --no-telemetry disables",
+        "layer; the default is bench_telemetry_<pid>.jsonl under "
+        "--telemetry-path, per-process so concurrent benches never "
+        "share a stream file); --no-telemetry disables",
     )
     ap.add_argument(
         "--no-telemetry", dest="telemetry",
         action="store_const", const=None,
         help="disable the telemetry stream",
+    )
+    ap.add_argument(
+        "--telemetry-path", default="/tmp", metavar="DIR",
+        help="directory for the default per-process telemetry stream "
+        "(default /tmp).  Stale bench_telemetry_<pid>.jsonl files "
+        "whose pid is dead are removed here at startup — default-on "
+        "telemetry must not leak one file per bench run forever",
     )
     ap.add_argument(
         "--progress-every", type=float, default=None, metavar="SEC",
@@ -336,7 +381,12 @@ def main(argv=None):
     # "Resume linking").  The per-process DEFAULT path gets the same
     # treatment as the metrics JSONL above — PID reuse must not append
     # this run onto a dead run's stream.
-    if args.telemetry == f"/tmp/bench_telemetry_{os.getpid()}.jsonl":
+    cleanup_stale_streams(args.telemetry_path)
+    if args.telemetry == _DEFAULT_TELEMETRY:
+        args.telemetry = os.path.join(
+            args.telemetry_path,
+            f"bench_telemetry_{os.getpid()}.jsonl",
+        )
         try:
             os.remove(args.telemetry)
         except OSError:

@@ -528,9 +528,408 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
     return rc
 
 
+# ---------------------------------------------- checking-as-a-service
+
+DEFAULT_STATE_DIR = os.path.expanduser("~/.ptt_serve")
+
+
+def _socket_of(args) -> str:
+    """Client socket resolution: explicit --socket wins; otherwise the
+    daemon's well-known location inside --state-dir."""
+    if getattr(args, "socket", None):
+        return args.socket
+    return os.path.join(
+        os.path.abspath(args.state_dir), "serve.sock"
+    )
+
+
+def _service_client(args):
+    from pulsar_tlaplus_tpu.service.client import ServiceClient
+
+    return ServiceClient(_socket_of(args), timeout=args.timeout)
+
+
+def _client_die(msg: str):
+    """Transport/daemon failure: exit 2 (no verification verdict).
+    Never 1 — the exit-code contract reserves 1 for violation/
+    deadlock, and a CI pipeline must be able to tell "the daemon was
+    down" from "the spec is broken"."""
+    print(f"tpu-tlc: {msg}", file=sys.stderr)
+    sys.exit(2)
+
+
+def _print_job_line(j: dict) -> None:
+    extra = ""
+    if j.get("state") == "done":
+        extra = (
+            f"  {j.get('status', '?')} "
+            f"{j.get('distinct_states', '?')} states"
+        )
+    elif j.get("error"):
+        extra = f"  {j['error'][:80]}"
+    print(
+        f"{j['job_id']}  {j['spec']:<16} {j['state']:<10} "
+        f"slices={j.get('slices', 0)} suspends={j.get('suspends', 0)}"
+        f"{extra}"
+    )
+
+
+def _service_exit(state: str, result, error) -> int:
+    """Exit-code contract mirroring ``check``: 0 clean, 1 violation/
+    deadlock, 2 failed/cancelled, 3 truncated (no verification
+    verdict)."""
+    if state == "done" and result:
+        status = result.get("status")
+        if status == "ok":
+            return 0
+        if status in ("violation", "deadlock"):
+            return 1
+        return 3  # truncated: NOT a verification result
+    return 2
+
+
+def _report_job_result(job_id: str, state: str, result, error) -> int:
+    if state == "done" and result:
+        status = result.get("status")
+        if status in ("violation", "deadlock"):
+            name = result.get("violation") or "Deadlock"
+            print(f"Error: job {job_id}: {name}.")
+            if result.get("trace"):
+                print("The behavior up to this point is:")
+                for i, (s, a) in enumerate(
+                    zip(
+                        result["trace"],
+                        ["<init>"] + (result.get("trace_actions") or []),
+                    )
+                ):
+                    print(f"  {i + 1}: [{a}] {s}")
+        print(
+            f"{result.get('distinct_states')} distinct states found, "
+            f"search depth (diameter) {result.get('diameter')}."
+        )
+        print(
+            f"Job {job_id} finished in {result.get('wall_s')}s over "
+            f"{result.get('slices')} slice(s) "
+            f"({result.get('suspends')} suspension(s))."
+        )
+        if status == "truncated":
+            print(
+                "WARNING: search truncated "
+                f"(stop reason: {result.get('stop_reason')}) — "
+                "absence of violations is inconclusive."
+            )
+    elif error:
+        print(f"Job {job_id} FAILED: {error}")
+    else:
+        print(f"Job {job_id}: {state}")
+    return _service_exit(state, result, error)
+
+
+def _cmd_serve(args) -> int:
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from pulsar_tlaplus_tpu.service.scheduler import ServiceConfig
+    from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+
+    def log(msg: str) -> None:
+        print(f"tpu-tlc serve: {msg}", file=sys.stderr, flush=True)
+
+    config = ServiceConfig(
+        state_dir=os.path.abspath(args.state_dir),
+        socket_path=args.socket or "",
+        slice_s=args.slice,
+        max_states=args.maxstates,
+        checkpoint_every=args.checkpoint_every,
+        keep_terminal=args.keep_terminal,
+        sub_batch=min(args.chunk, 4096),
+        specs=tuple(args.spec or ()),
+        prewarm_tiers=not args.no_tiers,
+    )
+    try:
+        daemon = ServiceDaemon(config, recover=args.recover, log=log)
+    except RuntimeError as e:  # another daemon holds the state dir
+        sys.exit(f"tpu-tlc: {e}")
+    if not args.no_prewarm:
+        daemon.prewarm()
+    daemon.start()
+    daemon.install_signal_handlers()
+    # the ready line goes to STDOUT so wrappers/tests can block on it
+    print(f"serving on {config.socket_path}", flush=True)
+    daemon.serve_forever(drain=args.drain)
+    return 0
+
+
+def _cmd_submit(args) -> int:
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+
+    cl = _service_client(args)
+    try:
+        jid = cl.submit(
+            args.spec,
+            os.path.abspath(args.config),
+            invariants=args.invariant,
+            max_states=args.maxstates,
+            time_budget_s=args.time_budget,
+        )
+    except (ServiceError, OSError) as e:
+        _client_die(f"submit failed: {e}")
+    print(jid)
+    if args.watch:
+        return _watch_stream(cl, jid, args.timeout)
+    if args.wait:
+        try:
+            r = cl.wait(jid, timeout=args.timeout)
+        except TimeoutError as e:
+            _client_die(str(e))
+        return _report_job_result(
+            jid, r.get("state"), r.get("result"), r.get("error")
+        )
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+
+    cl = _service_client(args)
+    try:
+        if args.job_id:
+            _print_job_line(cl.status(args.job_id))
+        else:
+            jobs = cl.status()
+            if not jobs:
+                print("(no jobs)")
+            for j in jobs:
+                _print_job_line(j)
+    except (ServiceError, OSError) as e:
+        _client_die(f"status failed: {e}")
+    return 0
+
+
+def _watch_stream(cl, job_id: str, timeout: float) -> int:
+    """Stream a job's relayed telemetry to stdout; returns the job's
+    exit code from the terminating ``done`` message."""
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+
+    try:
+        for msg in cl.watch(job_id, timeout_s=timeout):
+            if "event" in msg:
+                e = msg["event"]
+                kind = e.get("event", "?")
+                if kind == "level":
+                    print(
+                        f"[{e.get('run_id', '?')[:6]}] level "
+                        f"{e.get('level')}: {e.get('distinct_states')} "
+                        f"distinct, frontier {e.get('frontier')}, "
+                        f"{e.get('states_per_sec')} st/s",
+                        flush=True,
+                    )
+                elif kind in ("run_header", "result", "progress",
+                              "ckpt_frame"):
+                    print(
+                        f"[{e.get('run_id', '?')[:6]}] {kind} "
+                        + " ".join(
+                            f"{k}={e[k]}"
+                            for k in (
+                                "resume", "distinct_states", "wall_s",
+                                "frame_seq", "states_per_sec",
+                            )
+                            if k in e
+                        ),
+                        flush=True,
+                    )
+            elif "done" in msg:
+                d = msg["done"]
+                return _report_job_result(
+                    job_id, d.get("state"), d.get("result"),
+                    d.get("error"),
+                )
+            elif "error" in msg or not msg.get("ok", True):
+                _client_die(f"watch: {msg.get('error')}")
+    except (ServiceError, OSError) as e:
+        _client_die(f"watch failed: {e}")
+    return 2  # stream ended without a done record
+
+
+def _cmd_watch(args) -> int:
+    return _watch_stream(_service_client(args), args.job_id, args.timeout)
+
+
+def _cmd_cancel(args) -> int:
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+
+    cl = _service_client(args)
+    try:
+        state = cl.cancel(args.job_id)
+    except (ServiceError, OSError) as e:
+        _client_die(f"cancel failed: {e}")
+    print(f"{args.job_id}: {state}")
+    return 0
+
+
+def _cmd_cache(args) -> int:
+    from pulsar_tlaplus_tpu.utils import aot_cache
+
+    if args.clear:
+        n, b = aot_cache.clear()
+        print(f"cleared {n} entrie(s), {b / 1e6:.1f} MB")
+    elif args.evict_to is not None:
+        # enforce_cap treats cap <= 0 as "eviction disabled" (the
+        # PTT_AOT_MAX_BYTES contract); an explicit --evict-to 0 means
+        # evict everything
+        if args.evict_to <= 0:
+            n, b = aot_cache.clear()
+        else:
+            n, b = aot_cache.enforce_cap(args.evict_to)
+        print(f"evicted {n} entrie(s), {b / 1e6:.1f} MB")
+    st = aot_cache.stats()
+    print(
+        f"AOT executable cache at {st['dir']}: {st['entries']} "
+        f"entrie(s), {st['bytes'] / 1e6:.1f} MB "
+        f"(cap {st['max_bytes'] / 1e9:.1f} GB)"
+    )
+    return 0
+
+
+def _add_client_args(sp) -> None:
+    sp.add_argument(
+        "--state-dir", default=DEFAULT_STATE_DIR,
+        help="daemon state directory (socket lives at "
+        "<state-dir>/serve.sock; default ~/.ptt_serve)",
+    )
+    sp.add_argument(
+        "--socket", default=None,
+        help="daemon socket path (overrides --state-dir)",
+    )
+    sp.add_argument(
+        "--timeout", type=float, default=600.0,
+        help="client wait/stream timeout in seconds",
+    )
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="tpu-tlc")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser(
+        "serve",
+        help="resident multi-tenant checker daemon: warmed executables "
+        "for the spec registry, a FIFO job queue, and mesh "
+        "time-slicing between jobs (docs/service.md)",
+    )
+    ps.add_argument(
+        "state_dir", nargs="?", default=DEFAULT_STATE_DIR,
+        help="daemon state directory (socket, queue.json, per-job "
+        "dirs; default ~/.ptt_serve)",
+    )
+    ps.add_argument("--socket", default=None, help="override socket path")
+    ps.add_argument(
+        "--spec", action="append", default=None,
+        help="registry spec to prewarm at startup (repeatable; "
+        "default: every spec with a default cfg in specs/)",
+    )
+    ps.add_argument(
+        "--slice", type=float, default=2.0, metavar="SEC",
+        help="scheduling quantum: a running job suspends at its next "
+        "level boundary after SEC seconds when another job waits "
+        "(default 2.0)",
+    )
+    ps.add_argument(
+        "--maxstates", type=int, default=50_000_000,
+        help="service state ceiling (also the per-job default budget)",
+    )
+    ps.add_argument(
+        "--checkpoint-every", type=int, default=2,
+        help="levels between a running job's checkpoint frames",
+    )
+    ps.add_argument(
+        "--keep-terminal", type=int, default=512,
+        help="finished-job records retained for status/result "
+        "queries; oldest beyond this are pruned from the table and "
+        "disk (0 = keep forever)",
+    )
+    ps.add_argument("-chunk", type=int, default=4096)
+    ps.add_argument(
+        "--no-prewarm", action="store_true",
+        help="skip startup prewarm (first submit per spec pays the "
+        "compile warmup)",
+    )
+    ps.add_argument(
+        "--no-tiers", action="store_true",
+        help="prewarm only the base capacity tier (faster startup, "
+        "growth tiers lazy-compile)",
+    )
+    ps.add_argument(
+        "--recover", action="store_true",
+        help="reload queue.json and resume/re-run interrupted jobs "
+        "(after SIGTERM or a crash)",
+    )
+    ps.add_argument(
+        "--drain", action="store_true",
+        help="exit once the queue is idle (with --recover: complete "
+        "the persisted queue, then stop)",
+    )
+    ps.add_argument("-cpu", action="store_true", help="force the CPU backend")
+
+    pj = sub.add_parser(
+        "submit", help="queue a check job on the running daemon"
+    )
+    pj.add_argument("spec", help="registry spec name (e.g. compaction)")
+    pj.add_argument("config", help=".cfg constant bindings")
+    pj.add_argument(
+        "-invariant", action="append", default=None,
+        help="invariant to check (repeatable; default: cfg INVARIANTS)",
+    )
+    pj.add_argument("--maxstates", type=int, default=None)
+    pj.add_argument(
+        "--time-budget", type=float, default=None, metavar="SEC",
+        help="cumulative engine-wall budget across scheduling slices",
+    )
+    pj.add_argument(
+        "--wait", action="store_true",
+        help="block until the job finishes; exit code mirrors `check`",
+    )
+    pj.add_argument(
+        "--watch", action="store_true",
+        help="stream the job's relayed telemetry until it finishes",
+    )
+    _add_client_args(pj)
+
+    pst = sub.add_parser(
+        "status", help="job table (or one job) from the daemon"
+    )
+    pst.add_argument("job_id", nargs="?", default=None)
+    _add_client_args(pst)
+
+    pw = sub.add_parser(
+        "watch", help="stream a job's telemetry (level progress, "
+        "heartbeat, per-slice run headers) until it finishes",
+    )
+    pw.add_argument("job_id")
+    _add_client_args(pw)
+
+    pca = sub.add_parser("cancel", help="cancel a queued/running job")
+    pca.add_argument("job_id")
+    _add_client_args(pca)
+
+    pch = sub.add_parser(
+        "cache",
+        help="AOT executable cache inspector (--stats default)",
+    )
+    pch.add_argument(
+        "--stats", action="store_true",
+        help="print entry count / bytes / cap (the default action)",
+    )
+    pch.add_argument(
+        "--clear", action="store_true", help="delete every entry"
+    )
+    pch.add_argument(
+        "--evict-to", type=int, default=None, metavar="BYTES",
+        help="LRU-evict down to BYTES now (stores self-cap at "
+        "PTT_AOT_MAX_BYTES)",
+    )
+
     pc = sub.add_parser("check", help="exhaustive BFS model checking")
     pc.add_argument("spec", help="path to the .tla module (module 'compaction')")
     pc.add_argument("-config", help=".cfg file (defaults to SPEC's .cfg)")
@@ -712,6 +1111,16 @@ def main(argv=None):
     pc.add_argument("-chunk", type=int, default=4096)
     pc.add_argument("-maxstates", type=int, default=200_000_000)
     args = p.parse_args(argv)
+
+    if args.cmd != "check":
+        return {
+            "serve": _cmd_serve,
+            "submit": _cmd_submit,
+            "status": _cmd_status,
+            "watch": _cmd_watch,
+            "cancel": _cmd_cancel,
+            "cache": _cmd_cache,
+        }[args.cmd](args)
 
     args.xprof_window = None
     if args.xprof_levels:

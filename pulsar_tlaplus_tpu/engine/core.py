@@ -156,3 +156,34 @@ def build_trace(model, unpack1, gid: int, log):
         if i > 0:
             actions.append(names[action])
     return states, actions
+
+
+def replay_lane_trace(model, init_idx: int, lanes):
+    """Generic lane-chain trace replay for models without a bespoke
+    ``replay_trace`` (device-engine E7 protocol): action lanes are
+    deterministic functions, so replaying ``successors`` and selecting
+    each recorded lane reconstructs the behavior from the
+    ``init_idx``-th initial state — no packed rows ever leave the
+    device.  Used by ``DeviceChecker`` for every registry model beside
+    compaction (which replays through its Python oracle instead).
+
+    Returns (rendered states via ``to_pystate``, action names)."""
+    step = jax.jit(model.successors)
+    s = jax.tree_util.tree_map(
+        jnp.asarray, model.gen_initial(jnp.int32(init_idx))
+    )
+    to_py = getattr(model, "to_pystate", lambda x: x)
+    states = [to_py(jax.device_get(s))]
+    actions = []
+    names = getattr(model, "action_names", pyeval.ACTION_NAMES)
+    aids = getattr(model, "action_ids", None)
+    for lane in lanes:
+        succ, _valid = step(s)
+        s = jax.tree_util.tree_map(lambda x: x[int(lane)], succ)
+        states.append(to_py(jax.device_get(s)))
+        actions.append(
+            names[int(aids[int(lane)])]
+            if aids is not None
+            else str(int(lane))
+        )
+    return states, actions

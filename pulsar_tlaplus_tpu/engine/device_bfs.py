@@ -124,8 +124,16 @@ class DeviceChecker:
         heartbeat_s: Optional[float] = None,
         xprof_dir: Optional[str] = None,
         xprof_levels: Optional[Tuple[int, int]] = None,
+        suspend_hook=None,
     ):
         self.model = model
+        # cooperative suspend (checking-as-a-service): polled at level
+        # boundaries; returning "suspended" writes a resumable frame
+        # and exits with that stop_reason (the daemon's mesh
+        # time-slicing), "cancelled" exits without one.  Reassignable
+        # between run() calls — the service scheduler re-targets one
+        # pooled (warmed) checker at successive jobs.
+        self.suspend_hook = suspend_hook
         self.layout = model.layout
         if invariants is None:
             invariants = getattr(
@@ -2079,6 +2087,29 @@ class DeviceChecker:
                         t0, nv, level_sizes, bufs, truncated=True,
                         stop_reason="preempted",
                     )
+            elif self.suspend_hook is not None:
+                # cooperative time-slicing (the service scheduler):
+                # same boundary as the preemption watcher, but polled —
+                # "suspended" frames and exits resumably (the next job
+                # gets the device), "cancelled" discards the run.  A
+                # refused frame write (rows window lost) keeps running:
+                # suspending without a frame would lose the work.
+                why = self.suspend_hook()
+                if why == "cancelled":
+                    return self._result(
+                        t0, nv, level_sizes, bufs, truncated=True,
+                        stop_reason="cancelled",
+                    )
+                if why:
+                    saved = self._save_frame(
+                        bufs, st, rb, level_sizes, level_base, nf, nv,
+                        t0,
+                    )
+                    if saved:
+                        return self._result(
+                            t0, nv, level_sizes, bufs, truncated=True,
+                            stop_reason=str(why),
+                        )
             self._xprof_tick(len(level_sizes) + 1)
             if self._stage_timing:
                 self._log(
@@ -2660,9 +2691,17 @@ class DeviceChecker:
             )
         init_idx = -1 - g_end
         chain.reverse()
-        return self.model.replay_trace(
-            init_idx, [lane for _gid, lane in chain[1:]]
-        )
+        lanes = [lane for _gid, lane in chain[1:]]
+        replay = getattr(self.model, "replay_trace", None)
+        if replay is None:
+            # hand models beside compaction (bookkeeper, subscription,
+            # georeplication) replay generically through their
+            # successors kernels — the service registry needs traces
+            # from every spec, not just the flagship
+            from pulsar_tlaplus_tpu.engine.core import replay_lane_trace
+
+            return replay_lane_trace(self.model, init_idx, lanes)
+        return replay(init_idx, lanes)
 
     # ------------------------------------------------------------ result
 

@@ -233,3 +233,59 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
             out["compact_impl"] = hd.get("compact_impl")
         out["run_id"] = hd.get("run_id")
     return out
+
+
+# ------------------------------------------------------- service jobs
+
+
+def job_table(events: List[dict]) -> List[Dict[str, object]]:
+    """Per-job lifecycle rows from a daemon stream's ``job_*`` events
+    (schema v4, docs/service.md): one row per job_id in submission
+    order — spec, slices run, suspensions (mesh time-slice handoffs),
+    and the terminal status (``None`` while still in flight)."""
+    jobs: Dict[str, Dict[str, object]] = {}
+    for e in events:
+        ev = e.get("event", "")
+        if not ev.startswith("job_"):
+            continue
+        jid = e.get("job_id")
+        if jid is None:
+            continue
+        row = jobs.setdefault(
+            jid,
+            {
+                "job_id": jid, "spec": None, "slices": 0,
+                "suspends": 0, "status": None, "cancelled": False,
+            },
+        )
+        if ev == "job_submit":
+            row["spec"] = e.get("spec", row["spec"])
+        elif ev in ("job_start", "job_resume"):
+            row["spec"] = e.get("spec", row["spec"])
+            row["slices"] = max(
+                int(row["slices"]), int(e.get("slice", 0))
+            )
+        elif ev == "job_suspend":
+            row["suspends"] = int(row["suspends"]) + 1
+        elif ev == "job_result":
+            row["status"] = e.get("status")
+        elif ev == "job_cancel":
+            row["cancelled"] = True
+    return list(jobs.values())
+
+
+def render_job_table(events: List[dict]) -> str:
+    """Markdown view of :func:`job_table` for a daemon stream."""
+    rows = job_table(events)
+    if not rows:
+        return "(no job_* events in this stream)"
+    lines = [
+        "| job | spec | slices | suspends | status |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['job_id']} | {r['spec'] or '?'} | {r['slices']} "
+            f"| {r['suspends']} | {r['status'] or 'in flight'} |"
+        )
+    return "\n".join(lines)

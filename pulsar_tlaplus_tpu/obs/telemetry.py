@@ -45,10 +45,16 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # ``sweep`` records.  v3 (round 10): the device engines emit
 # ``compact`` records — per-stats-fetch deltas of the stream-compaction
 # dispatch counters (the log-shift vs sort differential signal) — and
-# their run headers carry ``compact_impl``.  Validators accept
-# <= SCHEMA_VERSION and hold a record only to the fields its OWN
-# version requires (FIELD_SINCE) — pre-r10 streams stay valid.
-SCHEMA_VERSION = 3
+# their run headers carry ``compact_impl``.  v4 (round 11): the checker
+# daemon (service/) emits ``job_*`` job-lifecycle events and ``serve``
+# daemon-lifecycle events into its own stream (docs/service.md); per-
+# job engine streams are unchanged, but a stream may now legitimately
+# interleave several run_ids (one per scheduling slice / daemon
+# restart) — the validator additionally requires per-run_id strictly
+# increasing ``seq``.  Validators accept <= SCHEMA_VERSION and hold a
+# record only to the fields its OWN version requires (FIELD_SINCE) —
+# pre-r10 streams stay valid.
+SCHEMA_VERSION = 4
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -61,6 +67,23 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("ckpt_frame", "retries"): 2,
     ("compact", "dispatches"): 3,
     ("compact", "impl"): 3,
+    # v4: the service daemon's job-lifecycle events (docs/service.md).
+    # The events are NEW at v4, so gating their required fields keeps a
+    # hypothetical pre-v4 stream using these names validator-clean.
+    ("job_submit", "job_id"): 4,
+    ("job_submit", "spec"): 4,
+    ("job_start", "job_id"): 4,
+    ("job_start", "spec"): 4,
+    ("job_start", "slice"): 4,
+    ("job_resume", "job_id"): 4,
+    ("job_resume", "spec"): 4,
+    ("job_resume", "slice"): 4,
+    ("job_suspend", "job_id"): 4,
+    ("job_suspend", "slice"): 4,
+    ("job_result", "job_id"): 4,
+    ("job_result", "status"): 4,
+    ("job_cancel", "job_id"): 4,
+    ("serve", "action"): 4,
 }
 EVENTS: Dict[str, Tuple[str, ...]] = {
     # run lifecycle
@@ -90,6 +113,19 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "sweep": ("chunk", "chunks", "swept", "edges"),
     # legacy differential stage timings (PTT_STAGE_TIMING runs)
     "stage_timing": ("stages",),
+    # checking-as-a-service job lifecycle (r11, service/scheduler.py):
+    # one submit -> N start/resume/suspend slices -> one result.  These
+    # live in the DAEMON's stream (service.jsonl) under the daemon's
+    # run_id; the per-job engine events stream separately under each
+    # slice's engine run_id (docs/service.md)
+    "job_submit": ("job_id", "spec"),
+    "job_start": ("job_id", "spec", "slice"),
+    "job_resume": ("job_id", "spec", "slice"),
+    "job_suspend": ("job_id", "slice"),
+    "job_result": ("job_id", "status"),
+    "job_cancel": ("job_id",),
+    # daemon lifecycle: start (socket, pid, warmed specs) / stop
+    "serve": ("action",),
 }
 
 

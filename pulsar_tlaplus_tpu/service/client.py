@@ -1,0 +1,102 @@
+"""Thin client for the checker daemon (``cli.py submit/status/watch``).
+
+Every method is one request over the unix socket; ``watch`` streams.
+The client never blocks the daemon: ``wait`` polls status client-side
+(the daemon's handlers all return promptly), so a slow consumer can
+never wedge a handler thread.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Optional
+
+from pulsar_tlaplus_tpu.service import protocol
+
+
+class ServiceError(RuntimeError):
+    """The daemon answered ``ok: false``."""
+
+
+class ServiceClient:
+    def __init__(self, socket_path: str, timeout: float = 30.0):
+        self.socket_path = socket_path
+        self.timeout = timeout
+
+    def _request(self, op: str, **fields) -> dict:
+        resp = protocol.request(
+            self.socket_path, op, timeout=self.timeout, **fields
+        )
+        if not resp.get("ok"):
+            raise ServiceError(
+                resp.get("error", f"daemon refused {op!r}")
+            )
+        return resp
+
+    # ------------------------------------------------------------ ops
+
+    def ping(self) -> dict:
+        return self._request("ping")
+
+    def submit(
+        self,
+        spec: str,
+        cfg_path: str,
+        invariants: Optional[List[str]] = None,
+        max_states: Optional[int] = None,
+        time_budget_s: Optional[float] = None,
+    ) -> str:
+        r = self._request(
+            "submit",
+            spec=spec,
+            cfg=cfg_path,
+            invariants=invariants,
+            max_states=max_states,
+            time_budget_s=time_budget_s,
+        )
+        return r["job_id"]
+
+    def status(self, job_id: Optional[str] = None):
+        r = self._request(
+            "status", **({"job_id": job_id} if job_id else {})
+        )
+        return r["job"] if job_id else r["jobs"]
+
+    def result(self, job_id: str) -> dict:
+        """Raw result response — ``{"pending": True, ...}`` while the
+        job is not terminal."""
+        return self._request("result", job_id=job_id)
+
+    def cancel(self, job_id: str) -> str:
+        return self._request("cancel", job_id=job_id)["state"]
+
+    def shutdown(self) -> dict:
+        return self._request("shutdown")
+
+    def wait(self, job_id: str, timeout: float = 600.0) -> dict:
+        """Poll until the job is terminal; returns the result response
+        (``state`` + ``result``/``error``).  Raises TimeoutError."""
+        deadline = time.monotonic() + timeout
+        while True:
+            r = self.result(job_id)
+            if not r.get("pending"):
+                return r
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {r.get('state')} after "
+                    f"{timeout}s"
+                )
+            time.sleep(0.1)
+
+    def watch(
+        self, job_id: str, timeout_s: float = 3600.0
+    ) -> Iterator[dict]:
+        """Stream the job's telemetry events (``{"event": rec}``
+        messages) ending with the ``{"done": {...}}`` summary."""
+        yield from protocol.stream(
+            self.socket_path,
+            "watch",
+            timeout=timeout_s + 30.0,
+            job_id=job_id,
+            timeout_s=timeout_s,
+        )

@@ -1,0 +1,120 @@
+"""Job model for the checker daemon.
+
+A job is one queued check: a registry spec, a ``.cfg`` constant
+binding, an optional invariant selection, and a state/time budget.
+Each job owns a directory under ``<state_dir>/jobs/<job_id>/`` holding
+its checkpoint frame (per-job isolation — two jobs time-slicing the
+mesh can never clobber each other's resumable state), its telemetry
+stream (one engine run_id per scheduling slice, chained by the
+frames' resume linking), and its final result record.
+
+Jobs serialize to plain JSON dicts so the daemon's ``queue.json``
+(written atomically on every transition) survives restarts —
+``serve --recover`` rebuilds the scheduler from it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+# job lifecycle: queued -> running -> (suspended -> running)* ->
+# done | failed | cancelled.  A suspended job holds a resumable
+# checkpoint frame; a crashed daemon's "running" jobs re-enter as
+# suspended (frame on disk) or queued (no frame yet) on recovery.
+QUEUED = "queued"
+RUNNING = "running"
+SUSPENDED = "suspended"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+STATES = (QUEUED, RUNNING, SUSPENDED, DONE, FAILED, CANCELLED)
+TERMINAL = frozenset((DONE, FAILED, CANCELLED))
+
+
+def new_job_id() -> str:
+    return uuid.uuid4().hex[:10]
+
+
+@dataclass
+class Job:
+    job_id: str
+    spec: str  # registry module name ("compaction", "bookkeeper", ...)
+    cfg_path: str  # .cfg constant bindings (server-local path)
+    dir: str  # <state_dir>/jobs/<job_id>
+    invariants: Optional[List[str]] = None  # None = the cfg INVARIANTS
+    max_states: Optional[int] = None  # None = the service default
+    time_budget_s: Optional[float] = None  # cumulative across slices
+    state: str = QUEUED
+    submitted_unix: float = field(default_factory=lambda: time.time())
+    started_unix: Optional[float] = None
+    finished_unix: Optional[float] = None
+    slices: int = 0  # scheduling quanta consumed
+    suspends: int = 0  # times preempted at a frame boundary
+    run_ids: List[str] = field(default_factory=list)  # one per slice
+    wall_s: float = 0.0  # cumulative engine wall (budget accounting)
+    progress: Optional[dict] = None  # last suspended slice's headline
+    #   counts, so a budget-exhausted completion still reports them
+    error: Optional[str] = None
+    cancel_requested: bool = False
+    result: Optional[dict] = None
+
+    # ------------------------------------------------------- paths
+
+    @property
+    def frame_path(self) -> str:
+        return os.path.join(self.dir, "frame.npz")
+
+    @property
+    def events_path(self) -> str:
+        return os.path.join(self.dir, "events.jsonl")
+
+    @property
+    def result_path(self) -> str:
+        return os.path.join(self.dir, "result.json")
+
+    # ------------------------------------------------ (de)serialize
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Job":
+        known = {k: d[k] for k in cls.__dataclass_fields__ if k in d}
+        job = cls(**known)
+        if job.state not in STATES:
+            raise ValueError(f"unknown job state {job.state!r}")
+        return job
+
+    def summary(self) -> Dict[str, object]:
+        """The status-wire view: everything but the (possibly large)
+        result payload, plus the headline result fields when done."""
+        s = {
+            "job_id": self.job_id,
+            "spec": self.spec,
+            "cfg_path": self.cfg_path,
+            "state": self.state,
+            "submitted_unix": round(self.submitted_unix, 3),
+            "slices": self.slices,
+            "suspends": self.suspends,
+            "run_ids": list(self.run_ids),
+            "wall_s": round(self.wall_s, 3),
+        }
+        if self.error:
+            s["error"] = self.error
+        if self.result:
+            for k in (
+                "distinct_states", "diameter", "violation",
+                "truncated", "stop_reason", "status",
+            ):
+                if k in self.result:
+                    s[k] = self.result[k]
+        return s
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL

@@ -1,0 +1,128 @@
+"""Wire protocol: JSONL request/response over a local unix socket.
+
+One connection carries one request and its response(s).  Every message
+is a single JSON object on one ``\\n``-terminated line (the same
+crash-durable line discipline as the telemetry streams):
+
+- request: ``{"op": "submit", ...}``
+- response: ``{"ok": true, ...}`` or ``{"ok": false, "error": "..."}``
+- ``watch`` responses stream: one ``{"ok": true, "streaming": true}``
+  acknowledgment, then ``{"event": {...}}`` lines relaying the job's
+  telemetry records (level progress, heartbeat, per-slice run headers
+  — each under the slice's run_id), terminated by ``{"done": {...}}``
+  with the job summary + result.
+
+The daemon listens on a filesystem socket inside its state dir, so
+reachability is filesystem permissions — no auth layer, same trust
+model as the checkpoint frames themselves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from typing import Iterator, Optional
+
+# requests the daemon understands (server.py dispatch table)
+OPS = (
+    "ping", "submit", "status", "result", "cancel", "watch", "shutdown",
+)
+
+# one message must fit memory comfortably; traces are bounded by spec
+# diameter, so this is generous
+MAX_LINE = 32 << 20
+
+
+class ProtocolError(RuntimeError):
+    """Malformed frame / oversized line / unexpected EOF."""
+
+
+def send_json(wfile, obj: dict) -> None:
+    """One message = one write of one complete line (a crashed peer
+    can tear at most the line in flight)."""
+    wfile.write(json.dumps(obj) + "\n")
+    wfile.flush()
+
+
+def recv_json(rfile) -> Optional[dict]:
+    """Next message, or None on clean EOF."""
+    line = rfile.readline(MAX_LINE + 1)
+    if not line:
+        return None
+    if len(line) > MAX_LINE:
+        raise ProtocolError(f"message exceeds {MAX_LINE} bytes")
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ProtocolError(f"unparseable message: {e}") from e
+    if not isinstance(obj, dict):
+        raise ProtocolError("message is not a JSON object")
+    return obj
+
+
+def connect(socket_path: str, timeout: Optional[float] = 10.0):
+    """Client-side connect; raises FileNotFoundError/ConnectionError
+    with the path in the message (the usual failure is a daemon that
+    is not running)."""
+    if not os.path.exists(socket_path):
+        raise FileNotFoundError(
+            f"no daemon socket at {socket_path!r} (is `serve` running?)"
+        )
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(socket_path)
+    except OSError:
+        s.close()
+        raise
+    return s
+
+
+def request(
+    socket_path: str, op: str, timeout: Optional[float] = 10.0, **fields
+) -> dict:
+    """One request -> the single (non-streaming) response."""
+    with connect(socket_path, timeout) as s:
+        r = s.makefile("r", encoding="utf-8")
+        w = s.makefile("w", encoding="utf-8")
+        send_json(w, {"op": op, **fields})
+        resp = recv_json(r)
+    if resp is None:
+        raise ProtocolError(f"daemon closed the connection on {op!r}")
+    return resp
+
+
+def stream(
+    socket_path: str, op: str, timeout: Optional[float] = None, **fields
+) -> Iterator[dict]:
+    """One request -> the streaming response sequence (``watch``):
+    yields every message after the acknowledgment, ending naturally at
+    the terminating ``done`` message (which is yielded too)."""
+    with connect(socket_path, timeout) as s:
+        r = s.makefile("r", encoding="utf-8")
+        w = s.makefile("w", encoding="utf-8")
+        send_json(w, {"op": op, **fields})
+        ack = recv_json(r)
+        if ack is None:
+            raise ProtocolError(f"daemon closed the connection on {op!r}")
+        if not ack.get("ok"):
+            yield ack
+            return
+        if not ack.get("streaming"):
+            yield ack
+            return
+        while True:
+            msg = recv_json(r)
+            if msg is None:
+                return
+            yield msg
+            if "done" in msg or "error" in msg:
+                return
+
+
+def error_response(msg: str) -> dict:
+    return {"ok": False, "error": msg}

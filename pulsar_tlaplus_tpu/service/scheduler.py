@@ -1,0 +1,727 @@
+"""Warmed-checker pool + FIFO/budget-slice scheduler.
+
+**Pool.**  The daemon holds one warmed :class:`DeviceChecker` per
+``(spec, constant bindings, invariant set, max_states)`` key.  Warming
+runs ``warmup(tiers=True)`` once — every jitted program for every
+capacity tier reachable under the service's state ceiling compiles (or
+loads from the AOT executable cache) up front, so a submit against a
+warmed key pays **zero** jit compiles (the test suite asserts this via
+the same ``set(ck._jits)`` harness as the capacity-tier prewarm
+tests).  The invariant set is part of the key because the engine bakes
+invariant evaluation into its append program.
+
+**Scheduler.**  FIFO with budget-slice preemption: the head job runs
+on the device until its slice budget expires *and* another job is
+waiting, at which point the engine's cooperative ``suspend_hook``
+fires at the next level boundary — the engine writes a resumable
+checkpoint frame into the job's own directory and returns
+``stop_reason="suspended"``; the job re-enters the FIFO tail and the
+next job gets the mesh.  One job's device buffers exist at a time;
+a suspended job's entire state is its frame on disk, which is what
+makes per-job isolation exact (the resumed run is the same run, by
+the round-7 crash-resume parity contract).
+
+The queue (jobs + FIFO order) persists to ``queue.json`` atomically on
+every transition, so a SIGTERM — or a crash — loses nothing:
+``serve --recover`` reloads it, re-queues interrupted jobs (suspended
+when their frame exists, queued otherwise), and completes the queue
+with the same results.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.service import jobs as jobmod
+from pulsar_tlaplus_tpu.service.jobs import Job
+
+
+@dataclass
+class ServiceConfig:
+    """Daemon-wide knobs (one engine geometry for the whole registry,
+    so warmed executables are shared across submits)."""
+
+    state_dir: str
+    socket_path: str = ""  # default: <state_dir>/serve.sock
+    slice_s: float = 2.0  # scheduling quantum (suspend granularity
+    #                       is the level boundary ABOVE this)
+    sub_batch: int = 2048
+    visited_cap: int = 1 << 16
+    frontier_cap: int = 1 << 14
+    max_states: int = 50_000_000  # service ceiling + default budget
+    checkpoint_every: int = 2
+    visited_impl: str = "fpset"
+    compact_impl: str = "logshift"
+    specs: Tuple[str, ...] = ()  # modules to prewarm at startup
+    spec_dir: str = ""  # where default <spec>.cfg files live
+    prewarm_tiers: bool = True
+    keep_terminal: int = 512  # finished-job records retained for
+    #   status/result queries; oldest beyond this are pruned (table,
+    #   queue.json, AND their jobs/<id>/ dirs) — a resident daemon
+    #   must not grow per-submit forever.  0 disables pruning.
+    telemetry_path: str = ""  # default: <state_dir>/service.jsonl
+
+    def __post_init__(self):
+        if not self.socket_path:
+            self.socket_path = os.path.join(self.state_dir, "serve.sock")
+        if not self.telemetry_path:
+            self.telemetry_path = os.path.join(
+                self.state_dir, "service.jsonl"
+            )
+        if not self.spec_dir:
+            self.spec_dir = os.path.normpath(
+                os.path.join(
+                    os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))
+                    ),
+                    "..",
+                    "specs",
+                )
+            )
+
+    @property
+    def jobs_dir(self) -> str:
+        return os.path.join(self.state_dir, "jobs")
+
+    @property
+    def queue_path(self) -> str:
+        return os.path.join(self.state_dir, "queue.json")
+
+
+class CheckerPool:
+    """Warmed DeviceChecker instances keyed by the job configuration.
+
+    Checkers are reused across jobs of the same key: per-job state
+    (checkpoint path, telemetry stream, budgets, the suspend hook) is
+    (re)assigned per scheduling slice, and ``run()`` rebuilds device
+    buffers from scratch (or from the job's frame on resume) — the
+    pooled object carries only compiled programs and tier sizes.
+    """
+
+    def __init__(self, config: ServiceConfig):
+        self.config = config
+        self._checkers: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- keys
+
+    @staticmethod
+    def _constants_sig(tlc_cfg) -> str:
+        return repr(
+            sorted((k, repr(v)) for k, v in tlc_cfg.constants.items())
+        )
+
+    def key_for(
+        self, spec: str, tlc_cfg, invariants: Tuple[str, ...],
+        max_states: Optional[int],
+    ) -> tuple:
+        return (
+            spec,
+            self._constants_sig(tlc_cfg),
+            tuple(invariants),
+            int(max_states or self.config.max_states),
+        )
+
+    # --------------------------------------------------------- build
+
+    @staticmethod
+    def build_model(spec: str, tlc_cfg):
+        from pulsar_tlaplus_tpu.models import registry
+
+        if spec not in registry.COMPILED:
+            raise ValueError(
+                f"spec {spec!r} is not in the compiled registry "
+                f"(known: {sorted(registry.COMPILED)}); the daemon "
+                "serves registry specs only"
+            )
+        model, _constants = registry.COMPILED[spec](tlc_cfg)
+        return model
+
+    def resolve_invariants(
+        self, spec: str, tlc_cfg, invariants: Optional[List[str]]
+    ) -> Tuple[str, ...]:
+        """Submitted invariant list (validated) or the cfg INVARIANTS."""
+        model = self.build_model(spec, tlc_cfg)
+        invs = tuple(
+            invariants if invariants is not None else tlc_cfg.invariants
+        )
+        unknown = [i for i in invs if i not in model.invariants]
+        if unknown:
+            raise ValueError(
+                f"unknown invariant(s) for {spec!r}: {unknown}"
+            )
+        return invs
+
+    def get(
+        self, spec: str, tlc_cfg, invariants: Tuple[str, ...],
+        max_states: Optional[int] = None,
+    ):
+        """(key, checker) — built cold if the key was never warmed."""
+        from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+        key = self.key_for(spec, tlc_cfg, invariants, max_states)
+        with self._lock:
+            ck = self._checkers.get(key)
+            if ck is None:
+                cfg = self.config
+                ck = DeviceChecker(
+                    self.build_model(spec, tlc_cfg),
+                    invariants=invariants,
+                    sub_batch=cfg.sub_batch,
+                    visited_cap=cfg.visited_cap,
+                    frontier_cap=cfg.frontier_cap,
+                    max_states=key[3],
+                    visited_impl=cfg.visited_impl,
+                    compact_impl=cfg.compact_impl,
+                )
+                self._checkers[key] = ck
+            return key, ck
+
+    def warm(
+        self, spec: str, cfg_path: Optional[str] = None,
+        tiers: Optional[bool] = None,
+    ) -> Tuple[tuple, float]:
+        """Build + warmup the checker for a spec's default (or given)
+        cfg; returns (key, compile_seconds).  Idempotent per key."""
+        from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+        if cfg_path is None:
+            cfg_path = os.path.join(
+                self.config.spec_dir, f"{spec}.cfg"
+            )
+        tlc_cfg = cfgmod.load(cfg_path)
+        invs = self.resolve_invariants(spec, tlc_cfg, None)
+        key, ck = self.get(spec, tlc_cfg, invs)
+        if ck._jits:
+            return key, 0.0  # already warmed
+        compile_s = ck.warmup(
+            tiers=(
+                self.config.prewarm_tiers if tiers is None else tiers
+            )
+        )
+        return key, compile_s
+
+    def warmed(self) -> List[tuple]:
+        with self._lock:
+            return [k for k, ck in self._checkers.items() if ck._jits]
+
+
+class Scheduler:
+    """FIFO + budget-slice preemption over the checker pool.
+
+    Thread model: one scheduler thread runs jobs (one at a time — the
+    whole point is that the single device is time-sliced, not shared);
+    server handler threads call :meth:`submit`/:meth:`cancel`/
+    :meth:`wait`/:meth:`snapshot` under the internal condition
+    variable.  ``stop()`` suspends the running job at its next level
+    boundary (resumable frame on disk), persists the queue, and joins.
+    """
+
+    def __init__(
+        self,
+        config: ServiceConfig,
+        pool: Optional[CheckerPool] = None,
+        telemetry=None,
+        log=None,
+    ):
+        self.config = config
+        self.pool = pool or CheckerPool(config)
+        self.tel = obs.as_telemetry(telemetry)
+        self._log = log or (lambda msg: None)
+        self.jobs: Dict[str, Job] = {}
+        self.fifo: deque = deque()
+        self.cv = threading.Condition()
+        self._persist_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._running_id: Optional[str] = None
+        os.makedirs(config.jobs_dir, exist_ok=True)
+
+    # ---------------------------------------------------- persistence
+
+    def persist(self) -> None:
+        """Atomic queue snapshot — called on every transition, so even
+        a kill -9 loses at most the in-flight transition (the frames
+        and result files are their own durable artifacts).  The
+        snapshot AND the replace happen under one lock: the scheduler
+        thread and the server's handler threads both persist, and the
+        last snapshot written must be the newest one taken (a shared
+        tmp name without the lock let one thread replace away
+        another's tmp mid-write)."""
+        self._prune_terminal()
+        with self._persist_lock:
+            with self.cv:
+                snap = {
+                    "version": 1,
+                    "jobs": [j.to_dict() for j in self.jobs.values()],
+                    "fifo": list(self.fifo),
+                    "running": self._running_id,
+                }
+            tmp = f"{self.config.queue_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self.config.queue_path)
+
+    def _prune_terminal(self) -> None:
+        """Retention cap: the oldest terminal jobs beyond
+        ``keep_terminal`` leave the table and their dirs leave disk.
+        Queued/running/suspended jobs are never touched."""
+        cap = self.config.keep_terminal
+        if cap <= 0:
+            return
+        with self.cv:
+            term = sorted(
+                (j for j in self.jobs.values() if j.terminal),
+                key=lambda j: j.finished_unix or 0.0,
+            )
+            drop = term[: max(0, len(term) - cap)]
+            for j in drop:
+                del self.jobs[j.job_id]
+        for j in drop:
+            shutil.rmtree(j.dir, ignore_errors=True)
+
+    def recover(self) -> int:
+        """Reload ``queue.json``: terminal jobs keep their records for
+        status/result queries; interrupted jobs re-enter the queue —
+        at the FRONT when they were running (their work is the
+        oldest), as suspended runs when their frame survived, as fresh
+        queued runs otherwise.  Returns the number of runnable jobs."""
+        try:
+            with open(self.config.queue_path) as f:
+                snap = json.load(f)
+        except FileNotFoundError:
+            return 0
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            raise ValueError(
+                f"unreadable queue state at {self.config.queue_path!r}:"
+                f" {e}"
+            ) from e
+        with self.cv:
+            for d in snap.get("jobs", []):
+                job = Job.from_dict(d)
+                self.jobs[job.job_id] = job
+            order = [
+                jid for jid in snap.get("fifo", []) if jid in self.jobs
+            ]
+            interrupted = snap.get("running")
+            if interrupted in self.jobs:
+                order.insert(0, interrupted)
+            n = 0
+            for jid in order:
+                job = self.jobs[jid]
+                if job.terminal:
+                    continue
+                if job.state == jobmod.RUNNING:
+                    # the daemon died mid-run: resumable iff the frame
+                    # reached disk
+                    job.state = (
+                        jobmod.SUSPENDED
+                        if os.path.exists(job.frame_path)
+                        else jobmod.QUEUED
+                    )
+                self.fifo.append(jid)
+                n += 1
+            self._running_id = None
+        self.persist()
+        self._log(f"recovered {n} runnable job(s) from queue.json")
+        return n
+
+    # -------------------------------------------------------- control
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ptt-scheduler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Graceful: the running job suspends at its next level
+        boundary (frame on disk), the queue persists, the thread
+        joins."""
+        self._stop.set()
+        with self.cv:
+            self.cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.persist()
+
+    def run_until_idle(self) -> None:
+        """Synchronous drain (in-process harnesses/tests): run slices
+        until no runnable job remains."""
+        while not self._stop.is_set():
+            job = self._claim()
+            if job is None:
+                return
+            self._run_slice(job)
+
+    # --------------------------------------------------------- submit
+
+    def submit(
+        self,
+        spec: str,
+        cfg_path: str,
+        invariants: Optional[List[str]] = None,
+        max_states: Optional[int] = None,
+        time_budget_s: Optional[float] = None,
+    ) -> Job:
+        """Validate eagerly (bad specs/cfgs/invariants fail the submit,
+        not the queue) and enqueue."""
+        from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+        cfg_path = os.path.abspath(cfg_path)
+        tlc_cfg = cfgmod.load(cfg_path)  # raises on missing/bad cfg
+        invs = self.pool.resolve_invariants(spec, tlc_cfg, invariants)
+        if max_states is not None and max_states > self.config.max_states:
+            raise ValueError(
+                f"max_states {max_states} exceeds the service ceiling "
+                f"{self.config.max_states} (serve --maxstates)"
+            )
+        jid = jobmod.new_job_id()
+        jdir = os.path.join(self.config.jobs_dir, jid)
+        os.makedirs(jdir, exist_ok=True)
+        job = Job(
+            job_id=jid,
+            spec=spec,
+            cfg_path=cfg_path,
+            dir=jdir,
+            # the RESOLVED set (submitted list or cfg INVARIANTS) so
+            # scheduling slices never rebuild the model to re-validate
+            invariants=list(invs),
+            max_states=max_states,
+            time_budget_s=time_budget_s,
+        )
+        with self.cv:
+            self.jobs[jid] = job
+            self.fifo.append(jid)
+            self.cv.notify_all()
+        self.persist()
+        self.tel.emit("job_submit", job_id=jid, spec=spec)
+        self._log(f"job {jid}: submitted ({spec} @ {cfg_path})")
+        return job
+
+    def cancel(self, job_id: str) -> Job:
+        with self.cv:
+            job = self._get(job_id)
+            if job.terminal:
+                return job
+            job.cancel_requested = True
+            if job.state in (jobmod.QUEUED, jobmod.SUSPENDED):
+                # not on the device: cancel immediately
+                try:
+                    self.fifo.remove(job_id)
+                except ValueError:
+                    pass
+                self._finish(job, jobmod.CANCELLED)
+            # a RUNNING job exits at its next level boundary via the
+            # suspend hook ("cancelled" stop reason)
+            self.cv.notify_all()
+        self.persist()
+        return job
+
+    # ---------------------------------------------------------- query
+
+    def _get(self, job_id: str) -> Job:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id!r}")
+        return job
+
+    def get(self, job_id: str) -> Job:
+        with self.cv:
+            return self._get(job_id)
+
+    def snapshot(self) -> List[dict]:
+        with self.cv:
+            return [
+                j.summary()
+                for j in sorted(
+                    self.jobs.values(), key=lambda j: j.submitted_unix
+                )
+            ]
+
+    def wait(
+        self, job_id: str, timeout: Optional[float] = None
+    ) -> Job:
+        """Block until the job is terminal (or timeout); returns it."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self.cv:
+            job = self._get(job_id)
+            while not job.terminal:
+                left = (
+                    None
+                    if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if left is not None and left <= 0:
+                    break
+                self.cv.wait(0.25 if left is None else min(left, 0.25))
+            return job
+
+    def idle(self) -> bool:
+        with self.cv:
+            return not self.fifo and self._running_id is None
+
+    # ------------------------------------------------------- the loop
+
+    def _runnable(self) -> bool:
+        return bool(self.fifo)
+
+    def _claim(self) -> Optional[Job]:
+        with self.cv:
+            if self._stop.is_set() or not self.fifo:
+                return None
+            jid = self.fifo.popleft()
+            job = self.jobs[jid]
+            self._running_id = jid
+            job.state = jobmod.RUNNING
+            if job.started_unix is None:
+                job.started_unix = time.time()
+        self.persist()
+        return job
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            job = self._claim()
+            if job is None:
+                with self.cv:
+                    if not self._stop.is_set() and not self.fifo:
+                        self.cv.wait(0.25)
+                continue
+            self._run_slice(job)
+
+    def _other_waiting(self) -> bool:
+        with self.cv:
+            return bool(self.fifo)
+
+    def _mk_hook(self, job: Job, deadline: Optional[float]):
+        """The engine's cooperative suspend hook, polled at level
+        boundaries: daemon shutdown and slice expiry suspend (frame +
+        requeue); a cancel request discards the run."""
+        polls = [0]
+
+        def hook() -> Optional[str]:
+            if job.cancel_requested:
+                return "cancelled"
+            if self._stop.is_set():
+                return "suspended"
+            # the engine polls BEFORE expanding each level, so the
+            # first poll of a slice precedes any progress: a timed
+            # suspend there (slice budget < frame-restore cost) would
+            # ping-pong two jobs forever at zero states/slice.  Every
+            # slice therefore advances >= one level before yielding.
+            polls[0] += 1
+            if polls[0] == 1:
+                return None
+            if (
+                deadline is not None
+                and time.monotonic() >= deadline
+                and self._other_waiting()
+            ):
+                return "suspended"
+            return None
+
+        return hook
+
+    def _run_slice(self, job: Job) -> None:
+        from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+        job.slices += 1
+        # resume iff a frame reached disk — even on slice 1: a crashed
+        # daemon's mid-first-slice frame (recover() marked the job
+        # suspended) must not be thrown away by a slice-count guard
+        resume = os.path.exists(job.frame_path)
+        try:
+            tlc_cfg = cfgmod.load(job.cfg_path)
+            invs = (
+                tuple(job.invariants)
+                if job.invariants is not None
+                # pre-resolved-era queue.json: resolve the cfg default
+                else self.pool.resolve_invariants(
+                    job.spec, tlc_cfg, None
+                )
+            )
+            _key, ck = self.pool.get(
+                job.spec, tlc_cfg, invs, job.max_states
+            )
+        except Exception as e:  # noqa: BLE001 — a bad job must not
+            #                      take the scheduler thread down
+            self._fail(job, e)
+            return
+        remaining = None
+        if job.time_budget_s is not None:
+            remaining = job.time_budget_s - job.wall_s
+            if remaining <= 0:
+                self._complete(job, None, budget_exhausted=True)
+                return
+        self.tel.emit(
+            "job_resume" if resume else "job_start",
+            job_id=job.job_id, spec=job.spec, slice=job.slices,
+        )
+        self._log(
+            f"job {job.job_id}: slice {job.slices} "
+            f"({'resume' if resume else 'start'})"
+        )
+        # per-slice assignment of the job's survivability + telemetry
+        # identity onto the pooled checker (engine state is otherwise
+        # rebuilt per run())
+        ck.checkpoint_path = job.frame_path
+        ck.rec.checkpoint_path = job.frame_path
+        ck.checkpoint_every = self.config.checkpoint_every
+        ck._telemetry_arg = job.events_path
+        ck.time_budget_s = remaining
+        ck.suspend_hook = self._mk_hook(
+            job, time.monotonic() + self.config.slice_s
+        )
+        try:
+            r = ck.run(resume=resume)
+        except Exception as e:  # noqa: BLE001
+            self._fail(job, e)
+            return
+        finally:
+            ck.suspend_hook = None
+            # drop the run's device buffers: a suspended job's state
+            # is its frame on disk, and the next job needs the HBM
+            ck.last_bufs = None
+        if ck._run_id:
+            job.run_ids.append(ck._run_id)
+        job.wall_s = float(r.wall_s)
+        if r.stop_reason == "suspended":
+            job.suspends += 1
+            job.progress = {
+                "distinct_states": int(r.distinct_states),
+                "diameter": int(r.diameter),
+                "level_sizes": [int(x) for x in r.level_sizes],
+            }
+            with self.cv:
+                job.state = jobmod.SUSPENDED
+                self._running_id = None
+                self.fifo.append(job.job_id)
+                self.cv.notify_all()
+            self.persist()
+            self.tel.emit(
+                "job_suspend", job_id=job.job_id, slice=job.slices
+            )
+            self._log(
+                f"job {job.job_id}: suspended at a frame boundary "
+                f"({r.distinct_states} states so far)"
+            )
+            return
+        if r.stop_reason == "cancelled":
+            with self.cv:
+                self._finish(job, jobmod.CANCELLED)
+            self.persist()
+            return
+        self._complete(job, r)
+
+    # ----------------------------------------------------- completion
+
+    @staticmethod
+    def result_record(job: Job, r) -> dict:
+        if r.violation and r.violation != "Deadlock":
+            status = "violation"
+        elif r.deadlock:
+            status = "deadlock"
+        elif r.truncated:
+            status = "truncated"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "distinct_states": r.distinct_states,
+            "diameter": r.diameter,
+            "level_sizes": [int(x) for x in r.level_sizes],
+            "truncated": bool(r.truncated),
+            "stop_reason": r.stop_reason,
+            "violation": r.violation,
+            "violation_gid": r.violation_gid,
+            "deadlock": bool(r.deadlock),
+            "trace": (
+                [repr(s) for s in r.trace]
+                if r.trace is not None
+                else None
+            ),
+            "trace_actions": (
+                list(r.trace_actions)
+                if r.trace_actions is not None
+                else None
+            ),
+            "wall_s": round(float(r.wall_s), 3),
+            "states_per_sec": round(float(r.states_per_sec), 1),
+            "hbm_recovered": int(r.hbm_recovered),
+            "fp_collision_prob": float(r.fp_collision_prob),
+            "slices": job.slices,
+            "suspends": job.suspends,
+            "run_ids": list(job.run_ids),
+        }
+
+    def _complete(self, job: Job, r, budget_exhausted: bool = False):
+        if budget_exhausted:
+            # no fresh CheckerResult — the budget died between slices;
+            # report the last suspended slice's progress, not nothing
+            job.result = {
+                "status": "truncated",
+                "truncated": True,
+                "stop_reason": "time_budget",
+                **(job.progress or {}),
+                "wall_s": round(float(job.wall_s), 3),
+                "slices": job.slices,
+                "suspends": job.suspends,
+                "run_ids": list(job.run_ids),
+            }
+        else:
+            job.result = self.result_record(job, r)
+        tmp = f"{job.result_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(job.result, f)
+        os.replace(tmp, job.result_path)
+        with self.cv:
+            self._finish(job, jobmod.DONE)
+        self.persist()
+        self._log(
+            f"job {job.job_id}: done ({job.result.get('status')}, "
+            f"{job.result.get('distinct_states')} states)"
+        )
+
+    def _fail(self, job: Job, e: BaseException) -> None:
+        job.error = repr(e)[:500]
+        with self.cv:
+            self._finish(job, jobmod.FAILED)
+        self.persist()
+        self._log(f"job {job.job_id}: FAILED ({job.error[:120]})")
+
+    def _finish(self, job: Job, state: str) -> None:
+        """Terminal transition; caller holds the cv."""
+        job.state = state
+        job.finished_unix = time.time()
+        if self._running_id == job.job_id:
+            self._running_id = None
+        # the frame is dead weight once the job is terminal
+        if state != jobmod.SUSPENDED:
+            try:
+                os.remove(job.frame_path)
+            except OSError:
+                pass
+        self.cv.notify_all()
+        self.tel.emit(
+            "job_result",
+            job_id=job.job_id,
+            status=(
+                job.result.get("status", state)
+                if job.result
+                else state
+            ),
+        )
+        if state == jobmod.CANCELLED:
+            self.tel.emit("job_cancel", job_id=job.job_id)

@@ -1,0 +1,402 @@
+"""The resident daemon: socket accept loop + graceful shutdown.
+
+``cli.py serve`` builds a :class:`ServiceDaemon`, prewarms the spec
+registry (AOT cache + capacity-tier prewarm, so warm submits pay zero
+jit compiles), and serves the JSONL protocol on a unix socket inside
+the state dir.  The scheduler runs in its own thread; signal handlers
+stay on the main thread, so SIGTERM/SIGINT trigger the graceful path:
+the running job suspends at its next checkpoint-frame boundary (its
+frame is on disk, its place in the queue persisted), the queue writes
+``queue.json``, and the process exits 0 — ``serve --recover`` then
+completes the queue with the same results (crash-resume parity).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import signal
+import socket
+import threading
+import time
+from typing import Optional
+
+from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.service import jobs as jobmod
+from pulsar_tlaplus_tpu.service import protocol
+from pulsar_tlaplus_tpu.service.scheduler import (
+    CheckerPool,
+    Scheduler,
+    ServiceConfig,
+)
+
+# how long a watch stream may idle-poll a job's event file between
+# records before giving up (the job may be waiting behind a long slice
+# of another job — that is normal, so this is generous)
+WATCH_POLL_S = 0.05
+
+
+class ServiceDaemon:
+    def __init__(
+        self,
+        config: ServiceConfig,
+        recover: bool = False,
+        log=None,
+        pool: Optional[CheckerPool] = None,
+    ):
+        self.config = config
+        os.makedirs(config.state_dir, exist_ok=True)
+        os.makedirs(config.jobs_dir, exist_ok=True)
+        self._log = log or (lambda m: None)
+        self._lock_fd: Optional[int] = None
+        # lock BEFORE touching queue.json (recover), the telemetry
+        # stream, or prewarm: the loser of a double-start race must
+        # fail fast and clean
+        self._acquire_state_lock()
+        self.tel = obs.Telemetry(config.telemetry_path)
+        self.pool = pool or CheckerPool(config)
+        self.sched = Scheduler(
+            config, pool=self.pool, telemetry=self.tel, log=self._log
+        )
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._shutdown_evt = threading.Event()
+        self._shutdown_done = threading.Event()
+        self._t0 = time.time()
+        self.warmed: list = []
+        if recover:
+            self.sched.recover()
+
+    def _acquire_state_lock(self) -> None:
+        """One daemon per state dir: a second `serve` would unlink the
+        live daemon's socket and both would rewrite queue.json from
+        diverging job tables (split-brain).  flock is kernel-released
+        on ANY process death, so a crashed daemon never wedges the
+        dir."""
+        path = os.path.join(self.config.state_dir, "serve.lock")
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            pid = b"?"
+            try:
+                pid = os.pread(fd, 32, 0).strip() or b"?"
+            except OSError:
+                pass
+            os.close(fd)
+            raise RuntimeError(
+                f"another daemon (pid {pid.decode()}) already serves "
+                f"{self.config.state_dir}; stop it first or use a "
+                "different state dir"
+            ) from None
+        os.ftruncate(fd, 0)
+        os.pwrite(fd, str(os.getpid()).encode(), 0)
+        self._lock_fd = fd
+
+    # ------------------------------------------------------- lifecycle
+
+    def prewarm(self) -> float:
+        """Warm every configured spec's checker (default cfg) so warm
+        submits pay zero jit compiles; returns total compile wall."""
+        total = 0.0
+        specs = self.config.specs
+        if not specs:
+            from pulsar_tlaplus_tpu.models import registry
+
+            specs = tuple(registry.COMPILED)
+        for spec in specs:
+            cfg_path = os.path.join(
+                self.config.spec_dir, f"{spec}.cfg"
+            )
+            if not os.path.exists(cfg_path):
+                self._log(
+                    f"prewarm: no default cfg for {spec!r} "
+                    f"({cfg_path}); skipping"
+                )
+                continue
+            try:
+                t0 = time.time()
+                key, compile_s = self.pool.warm(spec, cfg_path)
+                total += compile_s
+                self.warmed.append(spec)
+                self._log(
+                    f"prewarm: {spec} ready in {time.time() - t0:.1f}s "
+                    f"(compile {compile_s:.1f}s)"
+                )
+            except Exception as e:  # noqa: BLE001 — a bad default cfg
+                #                      must not block the daemon
+                self._log(f"prewarm: {spec} FAILED ({e!r:.200})")
+        return total
+
+    def start(self) -> None:
+        self.tel.emit(
+            "serve",
+            action="start",
+            socket=self.config.socket_path,
+            pid=os.getpid(),
+            warmed=list(self.warmed),
+        )
+        try:
+            os.remove(self.config.socket_path)
+        except OSError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(self.config.socket_path)
+        s.listen(16)
+        s.settimeout(0.5)
+        self._sock = s
+        self.sched.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ptt-serve-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+        self._log(f"serving on {self.config.socket_path}")
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful shutdown (main thread only)."""
+
+        def _handle(signum, frame):
+            self._log(
+                f"{signal.Signals(signum).name} received: suspending "
+                "the active job at its next frame boundary and "
+                "persisting the queue"
+            )
+            self.request_shutdown()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _handle)
+
+    def request_shutdown(self) -> None:
+        """Signal-safe: arms the shutdown path and nudges the
+        scheduler so the running job's suspend hook fires at its next
+        level boundary."""
+        self._shutdown_evt.set()
+        self.sched._stop.set()
+        with self.sched.cv:
+            self.sched.cv.notify_all()
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> None:
+        self._shutdown_evt.wait(timeout)
+        if self._shutdown_evt.is_set():
+            self.shutdown()
+
+    def serve_forever(self, drain: bool = False) -> None:
+        """Block until shutdown is requested (signal or client
+        ``shutdown`` op).  ``drain=True`` additionally exits once the
+        queue is idle — the ``serve --recover --drain`` shape: complete
+        the persisted queue, then stop."""
+        while not self._shutdown_evt.is_set():
+            if drain and self.sched.idle():
+                self.request_shutdown()
+                break
+            self._shutdown_evt.wait(0.2)
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._shutdown_done.is_set():
+            return
+        self._shutdown_done.set()
+        self._shutdown_evt.set()
+        # scheduler first: the running job suspends (frame + requeue)
+        # before the queue snapshot persists
+        self.sched.stop(timeout=600.0)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        try:
+            os.remove(self.config.socket_path)
+        except OSError:
+            pass
+        self.tel.emit("serve", action="stop", pid=os.getpid())
+        self.tel.close()
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)  # releases the flock
+            except OSError:
+                pass
+            self._lock_fd = None
+        self._log("shutdown complete (queue persisted)")
+
+    # ----------------------------------------------------- connection
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown_evt.is_set():
+            sock = self._sock
+            if sock is None:
+                return
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed under us: shutting down
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(600.0)
+        try:
+            r = conn.makefile("r", encoding="utf-8")
+            w = conn.makefile("w", encoding="utf-8")
+            try:
+                req = protocol.recv_json(r)
+            except protocol.ProtocolError as e:
+                protocol.send_json(w, protocol.error_response(str(e)))
+                return
+            if req is None:
+                return
+            op = req.get("op")
+            handler = getattr(self, f"_op_{op}", None)
+            if op not in protocol.OPS or handler is None:
+                protocol.send_json(
+                    w,
+                    protocol.error_response(
+                        f"unknown op {op!r} (known: {protocol.OPS})"
+                    ),
+                )
+                return
+            try:
+                handler(req, w)
+            except (KeyError, ValueError, TypeError, OSError) as e:
+                protocol.send_json(w, protocol.error_response(str(e)))
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-reply: its problem, not ours
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- handlers
+
+    def _op_ping(self, req, w) -> None:
+        with self.sched.cv:
+            counts: dict = {}
+            for j in self.sched.jobs.values():
+                counts[j.state] = counts.get(j.state, 0) + 1
+        protocol.send_json(
+            w,
+            {
+                "ok": True,
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - self._t0, 1),
+                "warmed": list(self.warmed),
+                "jobs": counts,
+            },
+        )
+
+    def _op_submit(self, req, w) -> None:
+        job = self.sched.submit(
+            spec=req["spec"],
+            cfg_path=req["cfg"],
+            invariants=req.get("invariants"),
+            max_states=req.get("max_states"),
+            time_budget_s=req.get("time_budget_s"),
+        )
+        protocol.send_json(
+            w, {"ok": True, "job_id": job.job_id, "state": job.state}
+        )
+
+    def _op_status(self, req, w) -> None:
+        jid = req.get("job_id")
+        if jid:
+            job = self.sched.get(jid)
+            protocol.send_json(w, {"ok": True, "job": job.summary()})
+        else:
+            protocol.send_json(
+                w, {"ok": True, "jobs": self.sched.snapshot()}
+            )
+
+    def _op_result(self, req, w) -> None:
+        job = self.sched.get(req["job_id"])
+        if not job.terminal:
+            protocol.send_json(
+                w,
+                {"ok": True, "pending": True, "state": job.state},
+            )
+            return
+        protocol.send_json(
+            w,
+            {
+                "ok": True,
+                "state": job.state,
+                "result": job.result,
+                "error": job.error,
+            },
+        )
+
+    def _op_cancel(self, req, w) -> None:
+        job = self.sched.cancel(req["job_id"])
+        protocol.send_json(w, {"ok": True, "state": job.state})
+
+    def _op_watch(self, req, w) -> None:
+        """Relay the job's telemetry stream (per-slice run headers,
+        level progress, heartbeat, results — each under its slice's
+        run_id) until the job is terminal, then send ``done`` with the
+        summary + result."""
+        job = self.sched.get(req["job_id"])
+        timeout_s = float(req.get("timeout_s", 3600.0))
+        protocol.send_json(w, {"ok": True, "streaming": True})
+        deadline = time.monotonic() + timeout_s
+        pos = 0
+        buf = ""
+        while True:
+            # observe terminal BEFORE draining: records written between
+            # a drain and the terminal transition are caught by the
+            # next iteration's drain, which runs before we report done
+            terminal = job.terminal
+            emitted = False
+            if os.path.exists(job.events_path):
+                with open(job.events_path) as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                buf += chunk
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line: next poll re-reads
+                    protocol.send_json(w, {"event": rec})
+                    emitted = True
+            if terminal:
+                # one final drain already happened above; report
+                protocol.send_json(
+                    w,
+                    {
+                        "done": {
+                            **job.summary(),
+                            "result": job.result,
+                            "error": job.error,
+                        }
+                    },
+                )
+                return
+            if time.monotonic() >= deadline:
+                protocol.send_json(
+                    w,
+                    protocol.error_response(
+                        f"watch timed out after {timeout_s}s "
+                        f"(job {job.job_id} still {job.state})"
+                    ),
+                )
+                return
+            if not emitted:
+                time.sleep(WATCH_POLL_S)
+
+    def _op_shutdown(self, req, w) -> None:
+        protocol.send_json(w, {"ok": True, "stopping": True})
+        # reply first, then arm: the main thread (wait_shutdown) or
+        # the caller of shutdown() performs the actual stop
+        self.request_shutdown()

@@ -37,6 +37,12 @@ tampering), and (c) the cache key folds in compile-affecting
 environment (``XLA_FLAGS``, ``LIBTPU_INIT_ARGS``, ``JAX_ENABLE_X64``)
 so changing those between runs can never load a stale executable
 compiled under different options.
+
+Bounded size (r11): the cache is capped (``PTT_AOT_MAX_BYTES``,
+default 8 GiB) with mtime-LRU eviction after every store — loads
+touch their entry, so a resident checker daemon's warmed registry
+stays hot while stale experiments age out.  ``cli.py cache`` is the
+operator inspector (``--stats`` / ``--clear``).
 """
 
 from __future__ import annotations
@@ -44,7 +50,7 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 
@@ -56,6 +62,23 @@ _MAGIC = b"PTTAOTX2"
 # compile-affecting environment folded into the cache key (ADVICE r5:
 # XLA_FLAGS changes must never load a stale executable)
 _COMPILE_ENV = ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "JAX_ENABLE_X64")
+
+# size cap with LRU eviction (r11): a resident daemon warming four
+# specs across capacity tiers writes hundreds of entries; the cache
+# must not grow unboundedly.  mtime is the recency signal — loads
+# touch their entry (os.utime) so a warm daemon's working set stays
+# resident while one-off experiments age out.  Override with
+# PTT_AOT_MAX_BYTES (0 disables eviction).
+DEFAULT_MAX_BYTES = 8 << 30
+
+
+def max_bytes() -> int:
+    try:
+        return int(
+            os.environ.get("PTT_AOT_MAX_BYTES", DEFAULT_MAX_BYTES)
+        )
+    except ValueError:
+        return DEFAULT_MAX_BYTES
 
 
 def _cache_dir() -> str:
@@ -163,6 +186,81 @@ def _load(path: str):
     return se.deserialize_and_load(payload, in_tree, out_tree)
 
 
+def _entries():
+    """(path, size, mtime) for every ``*.aotx`` entry, oldest first.
+    Unreadable entries (racing eviction/writers) are skipped."""
+    d = _cache_dir()
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".aotx"):
+            continue
+        p = os.path.join(d, name)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        out.append((p, st.st_size, st.st_mtime))
+    out.sort(key=lambda e: e[2])
+    return out
+
+
+def stats() -> Dict[str, object]:
+    """Cache inspector view: entry count, byte total, age span, cap.
+    Never raises — a missing directory is an empty cache."""
+    es = _entries()
+    return {
+        "dir": _cache_dir(),
+        "entries": len(es),
+        "bytes": sum(s for _p, s, _m in es),
+        "max_bytes": max_bytes(),
+        "oldest_mtime": es[0][2] if es else None,
+        "newest_mtime": es[-1][2] if es else None,
+    }
+
+
+def clear() -> Tuple[int, int]:
+    """Delete every cache entry; returns (entries_removed, bytes)."""
+    n = b = 0
+    for p, size, _m in _entries():
+        try:
+            os.unlink(p)
+            n += 1
+            b += size
+        except OSError:
+            pass
+    return n, b
+
+
+def enforce_cap(cap: Optional[int] = None) -> Tuple[int, int]:
+    """Evict least-recently-used entries (mtime order — loads touch
+    their entry) until the cache fits ``cap`` bytes (default
+    :func:`max_bytes`); returns (entries_evicted, bytes_evicted).
+    A cap of 0 (or negative) disables eviction.  Called after every
+    store, so a resident daemon warming the whole registry converges
+    to the cap instead of growing forever."""
+    cap = max_bytes() if cap is None else cap
+    if cap <= 0:
+        return 0, 0
+    es = _entries()
+    total = sum(s for _p, s, _m in es)
+    n = b = 0
+    for p, size, _m in es:
+        if total <= cap:
+            break
+        try:
+            os.unlink(p)
+        except OSError:
+            continue
+        total -= size
+        n += 1
+        b += size
+    return n, b
+
+
 def _store(path: str, compiled) -> None:
     from jax.experimental import serialize_executable as se
 
@@ -218,6 +316,12 @@ class _AJit:
                 comp = _load(path)
                 self.events[sig] = "hit"
                 self._paths[sig] = path
+                try:
+                    # refresh recency: a loaded entry is in use, so
+                    # LRU eviction must not see it as cold
+                    os.utime(path)
+                except OSError:
+                    pass
                 return comp
             except Exception as e:  # noqa: BLE001
                 # digest-mismatch / truncated / unpicklable /
@@ -242,6 +346,7 @@ class _AJit:
         if trusted:
             try:
                 _store(path, comp)
+                enforce_cap()
             except Exception:  # noqa: BLE001
                 pass  # serialization unsupported: still usable in-process
         return comp

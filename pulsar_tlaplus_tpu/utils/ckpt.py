@@ -11,9 +11,12 @@ preemption contract).
 
 Design rules every engine follows:
 
-- **Atomicity**: a frame is written to ``<path>.tmp.npz`` and
-  ``os.replace``d over the target, so a crash mid-write can never
-  leave a half-frame where a resumable one used to be.
+- **Atomicity**: a frame is written to a per-writer-unique
+  ``<path>.tmp.<pid>.<tid>.npz`` and ``os.replace``d over the target,
+  so a crash mid-write can never leave a half-frame where a resumable
+  one used to be — and two writers racing on one path (a job handed
+  between daemon scheduling slices) each publish a complete frame,
+  never each other's half-filled tmp.
 - **Signature**: every frame embeds a config signature (model hash,
   invariant set, key geometry, visited impl, engine format revision).
   ``load_frame`` refuses a frame written under a different
@@ -31,10 +34,12 @@ Design rules every engine follows:
   NFS hiccup) retries with bounded exponential backoff instead of
   killing an hours-long run over one bad write; the retry count comes
   back to the caller (the ``ckpt_retries`` telemetry breadcrumb).
-  Stale ``<path>.tmp.npz`` left by a crash mid-write is removed at
-  run start (:func:`cleanup_stale_tmp`) — the atomic ``os.replace``
-  already guarantees it never shadows a valid frame, but a dead
-  multi-GB temp file must not squat the checkpoint volume either.
+  Stale ``<path>.tmp.*.npz`` left by a crash mid-write is removed at
+  run start (:func:`cleanup_stale_tmp`, scoped to the one frame path
+  so sibling jobs sharing a checkpoint dir are never touched) — the
+  atomic ``os.replace`` already guarantees it never shadows a valid
+  frame, but a dead multi-GB temp file must not squat the checkpoint
+  volume either.
 """
 
 from __future__ import annotations
@@ -93,9 +98,15 @@ def save_frame(
     Transient ``OSError`` (disk full, EIO) retries with bounded
     exponential backoff; only a persistent failure propagates.  The
     ``PTT_FAULT=ckpt_fail@frame:N`` injection raises a synthetic
-    ENOSPC on frame N's first attempt, exercising exactly this path."""
+    ENOSPC on frame N's first attempt, exercising exactly this path.
+
+    The tmp name is unique per writer (pid + thread id): two writers
+    racing on one path — a job handed between daemon slices, a
+    split-brain daemon pair — each publish a COMPLETE frame through
+    their own tmp, so ``os.replace`` can never install a half-written
+    file another writer was still filling (last complete write wins)."""
     t0 = time.perf_counter()
-    tmp = path + ".tmp.npz"
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}.npz"
     extra = {}
     if meta:
         extra["__meta__"] = np.frombuffer(
@@ -142,18 +153,32 @@ def save_frame(
 
 
 def cleanup_stale_tmp(path: Optional[str]) -> bool:
-    """Remove a stale ``<path>.tmp.npz`` left by a crash mid-write
-    (engines call this at run start).  The atomic ``os.replace``
-    already guarantees a tmp never shadows a valid frame; this is
-    disk hygiene — a dead multi-GB temp must not squat the checkpoint
-    volume.  Returns True when something was removed."""
+    """Remove stale ``<path>.tmp.*.npz`` temps (and the pre-r11 fixed
+    ``<path>.tmp.npz`` name) left by a crash mid-write — engines call
+    this at run start.  The atomic ``os.replace`` already guarantees a
+    tmp never shadows a valid frame; this is disk hygiene — a dead
+    multi-GB temp must not squat the checkpoint volume.  Scoped to
+    THIS frame path only: sibling frames sharing the directory (other
+    jobs' run_ids in a service checkpoint dir) are never touched.
+    Returns True when something was removed."""
     if not path:
         return False
+    d, base = os.path.split(path)
+    prefix = base + ".tmp."
+    removed = False
     try:
-        os.remove(path + ".tmp.npz")
-        return True
+        names = os.listdir(d or ".")
     except OSError:
         return False
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(".npz")):
+            continue
+        try:
+            os.remove(os.path.join(d, name))
+            removed = True
+        except OSError:
+            pass
+    return removed
 
 
 def frame_meta(d) -> Dict[str, object]:

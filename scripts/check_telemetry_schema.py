@@ -11,11 +11,14 @@ File kind is sniffed by extension: ``.jsonl`` = event stream, ``.json``
 = bench artifact (the driver wrapper ``{"parsed": {...}}`` and the raw
 bench line both work).
 
-Stream rules (schema v3, ``obs/telemetry.py`` EVENTS is authoritative;
+Stream rules (schema v4, ``obs/telemetry.py`` EVENTS is authoritative;
 older records are held only to their own version's fields):
 every line parses as an object; carries ``v``/``event``/``t``/
 ``run_id``; ``v`` <= the supported version; ``t`` is monotonically
-non-decreasing per run_id; known event types carry their required
+non-decreasing per run_id; ``seq`` is STRICTLY increasing per run_id
+(streams legitimately interleave several run_ids since r11 — one per
+daemon scheduling slice or restart — but a torn/duplicated writer
+within one run must fail); known event types carry their required
 fields (r9 additions: ``ckpt_frame`` carries the frame writer's
 ``retries`` count, the liveness engine emits per-chunk ``sweep``
 records, and the sharded engine's ``flush`` records carry the 5-wide
@@ -23,7 +26,8 @@ fpm keys — real ``valid_lanes`` + ``max_probe_rounds``; r10: the
 device engines emit ``compact`` records — per-fetch deltas of the
 stream-compaction dispatch counters with the active ``impl`` — held
 to their fields only at v3 via FIELD_SINCE, so pre-r10 streams stay
-validator-clean).  Bench rules: ``bench_schema`` >= 2 requires the
+validator-clean; r11: the checker daemon's ``job_*`` + ``serve``
+lifecycle events, required fields gated at v4).  Bench rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
 ``ckpt_retries``, >= 5 additionally ``compact_impl``.
@@ -74,6 +78,7 @@ def validate_stream(path: str) -> List[str]:
     """All schema violations in one stream (empty list = clean)."""
     errors: List[str] = []
     last_t: dict = {}
+    last_seq: dict = {}
     n = 0
     try:
         f = open(path)
@@ -116,6 +121,23 @@ def validate_stream(path: str) -> List[str]:
                         f"{rid} ({rec['t']} < {last_t[rid]})"
                     )
                 last_t[rid] = rec["t"]
+            if isinstance(rec.get("seq"), int):
+                # per-run_id STRICT monotonicity: interleaved run_ids
+                # (a daemon stream, per-slice job streams) are legal,
+                # but one run's writer repeating or reordering seq is
+                # a torn/duplicated stream
+                rid = rec["run_id"]
+                prev = last_seq.get(rid)
+                if prev is not None and rec["seq"] <= prev:
+                    errors.append(
+                        f"{path}:{i}: seq not increasing for run "
+                        f"{rid} ({rec['seq']} <= {prev})"
+                    )
+                last_seq[rid] = rec["seq"]
+            else:
+                errors.append(
+                    f"{path}:{i}: non-integer seq {rec.get('seq')!r}"
+                )
             req = EVENTS.get(rec["event"])
             if req:
                 # a record is held only to the fields its OWN schema

@@ -54,6 +54,11 @@ def main(argv=None) -> int:
         help="print ONLY the fpset_*/ckpt_* BENCH keys as one JSON "
         "object",
     )
+    ap.add_argument(
+        "--jobs", action="store_true",
+        help="render the per-job lifecycle table of a checker-daemon "
+        "stream (schema v4 job_* events, docs/service.md)",
+    )
     args = ap.parse_args(argv)
 
     paths = [args.stream] + ([args.compare] if args.compare else [])
@@ -74,6 +79,10 @@ def main(argv=None) -> int:
 
     if args.bench_keys:
         print(json.dumps(report.bench_keys(streams[0][1]), indent=2))
+        return 0
+
+    if args.jobs:
+        print(report.render_job_table(streams[0][1]))
         return 0
 
     hd = report.header(streams[0][1])

@@ -167,3 +167,61 @@ def test_simulation_finds_durability_violation():
     # final state: some confirmed entry with no surviving replica
     final = sres.trace[-1]
     assert final["lac"] >= 1
+
+
+# ---- pinned oracle counts (r11, checking-as-a-service) --------------
+# The daemon's multi-spec registry needs a second exact-parity workload
+# beside compaction's published 45,198/253,361 figures: pin the Python
+# oracle's reachable-state counts for bookkeeper and hold every engine
+# the registry dispatches to them.  Derived once from the interpreter
+# BFS on specs/bookkeeper.tla (the "shipped" count is re-derived inline
+# below; the meatier EntryLimit=3 run takes ~2 s and is asserted
+# against the literal only).
+
+ORACLE_CFG = BookkeeperConstants(entry_limit=3)
+SHIPPED_STATES, SHIPPED_DIAMETER = 297, 14    # specs/bookkeeper.cfg
+ORACLE_STATES, ORACLE_DIAMETER = 2257, 20     # EntryLimit = 3
+
+
+def test_shipped_cfg_pinned_oracle_count(module):
+    """The daemon's default bookkeeper binding (specs/bookkeeper.cfg):
+    interpreter, host engine, and the service registry's device engine
+    all reproduce the pinned count."""
+    c = CONFIGS["shipped"]
+    ri = InterpChecker(spec_for(module, c)).run()
+    assert (ri.distinct_states, ri.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    rh = Checker(BookkeeperModel(c), frontier_chunk=256).run()
+    assert (rh.distinct_states, rh.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    rd = DeviceChecker(
+        BookkeeperModel(c), sub_batch=256, visited_cap=1 << 12,
+        frontier_cap=1 << 10,
+    ).run()
+    assert (rd.distinct_states, rd.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    assert rd.violation is None and not rd.deadlock
+
+
+def test_entry_limit3_pinned_oracle_count(module):
+    """EntryLimit=3 is the meatier pinned workload (2,257 states,
+    diameter 20 — the bookkeeper analog of compaction's 253k oracle
+    regime, scaled to the CPU-mesh test budget)."""
+    ri = InterpChecker(spec_for(module, ORACLE_CFG)).run()
+    assert (ri.distinct_states, ri.diameter) == (
+        ORACLE_STATES, ORACLE_DIAMETER,
+    )
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    rd = DeviceChecker(
+        BookkeeperModel(ORACLE_CFG), sub_batch=256,
+        visited_cap=1 << 13, frontier_cap=1 << 11,
+    ).run()
+    assert (rd.distinct_states, rd.diameter) == (
+        ORACLE_STATES, ORACLE_DIAMETER,
+    )

@@ -2,6 +2,7 @@
 to the exact published state count, and traces must span checkpoints."""
 
 import dataclasses
+import os
 
 import pytest
 
@@ -56,3 +57,126 @@ def test_trace_spans_checkpoint(tmp_path):
     assert_valid_counterexample(
         pe.SHIPPED_CFG, r2.trace, r2.trace_actions, "CompactedLedgerLeak"
     )
+
+
+# ---- concurrent frame writers (r11, checking-as-a-service) ----------
+# Two run_ids sharing a checkpoint dir (the daemon's jobs/<id>/ layout
+# collapses to this when paths collide) must never clobber each other's
+# frames, tmp files, or stale-tmp cleanup.
+
+
+def _hammer_frames(path, sig, run_id, payload, n, errors):
+    from pulsar_tlaplus_tpu.utils import ckpt
+    import numpy as np
+
+    try:
+        for seq in range(n):
+            ckpt.save_frame(
+                path, sig,
+                {"payload": np.full(256, payload, np.int64)},
+                meta={"run_id": run_id, "frame_seq": seq},
+            )
+    except Exception as e:  # noqa: BLE001 — surfaced by the test body
+        errors.append(e)
+
+
+def test_concurrent_writers_same_path_never_torn(tmp_path):
+    """Two writers racing on ONE path: every load observes a COMPLETE
+    frame from one of them (per-writer-unique tmp names make the
+    os.replace publish atomic even under contention; the pre-r11 fixed
+    tmp name let writer A install writer B's half-filled tmp)."""
+    import threading
+
+    import numpy as np
+
+    from pulsar_tlaplus_tpu.utils import ckpt
+
+    path = str(tmp_path / "frame.npz")
+    sig = ckpt.config_sig(test="race")
+    errors: list = []
+    writers = [
+        threading.Thread(
+            target=_hammer_frames,
+            args=(path, sig, rid, val, 30, errors),
+        )
+        for rid, val in (("run-a", 1), ("run-b", 2))
+    ]
+    for t in writers:
+        t.start()
+    torn = []
+    while any(t.is_alive() for t in writers):
+        try:
+            d = ckpt.load_frame(path, sig)
+        except FileNotFoundError:
+            continue  # before the first publish
+        except ValueError as e:
+            torn.append(repr(e))
+            break
+        p = np.asarray(d["payload"])
+        if not (p == p[0]).all() or int(p[0]) not in (1, 2):
+            torn.append(f"mixed payload {set(p.tolist())}")
+            break
+    for t in writers:
+        t.join()
+    assert not errors, errors
+    assert not torn, torn
+    # final frame: complete, signed, from one of the two writers
+    d = ckpt.load_frame(path, sig)
+    assert int(np.asarray(d["payload"])[0]) in (1, 2)
+    assert ckpt.frame_meta(d)["run_id"] in ("run-a", "run-b")
+    # no tmp survives the writers
+    assert not [
+        n for n in os.listdir(tmp_path) if ".tmp." in n
+    ]
+
+
+def test_shared_dir_frames_and_cleanup_are_isolated(tmp_path):
+    """Two run_ids with sibling frame paths in ONE dir: concurrent
+    writes land in their own frames, and one path's stale-tmp cleanup
+    never touches the sibling's tmp or frame."""
+    import threading
+
+    import numpy as np
+
+    from pulsar_tlaplus_tpu.utils import ckpt
+
+    pa = str(tmp_path / "frame.a.npz")
+    pb = str(tmp_path / "frame.b.npz")
+    sig = ckpt.config_sig(test="shared-dir")
+    errors: list = []
+    ts = [
+        threading.Thread(
+            target=_hammer_frames, args=(p, sig, rid, v, 20, errors)
+        )
+        for p, rid, v in ((pa, "run-a", 1), (pb, "run-b", 2))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors
+    da, db = ckpt.load_frame(pa, sig), ckpt.load_frame(pb, sig)
+    assert int(np.asarray(da["payload"])[0]) == 1
+    assert int(np.asarray(db["payload"])[0]) == 2
+    assert ckpt.frame_meta(da)["run_id"] == "run-a"
+    assert ckpt.frame_meta(db)["run_id"] == "run-b"
+    # stale tmps: cleanup is scoped to ITS frame path — a crashed
+    # writer's debris for A never takes B's live tmp (or frame) along
+    for stale in (
+        pa + ".tmp.npz",              # pre-r11 fixed name
+        pa + ".tmp.999.888.npz",      # per-writer name, dead writer
+    ):
+        with open(stale, "wb") as f:
+            f.write(b"half-written")
+    live_b = pb + ".tmp.777.666.npz"
+    with open(live_b, "wb") as f:
+        f.write(b"in flight")
+    assert ckpt.cleanup_stale_tmp(pa)
+    assert not [
+        n for n in os.listdir(tmp_path)
+        if n.startswith("frame.a.npz.tmp.")
+    ]
+    assert os.path.exists(live_b)  # B's tmp untouched
+    assert os.path.exists(pb)      # B's frame untouched
+    assert not ckpt.cleanup_stale_tmp(pa)  # idempotent: nothing left
+    os.remove(live_b)
