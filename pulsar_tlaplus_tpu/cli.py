@@ -768,6 +768,111 @@ def _cmd_cancel(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    """Telemetry stream(s) -> Perfetto-loadable Chrome trace JSON."""
+    from pulsar_tlaplus_tpu.obs import report, trace
+
+    # label streams by basename stem; the documented
+    # `trace jobs/*/events.jsonl` shape would name every process
+    # "events", so collisions pull in the parent directory (the job id)
+    stems = [
+        os.path.splitext(os.path.basename(p))[0] for p in args.stream
+    ]
+
+    def label(i: int) -> str:
+        if stems.count(stems[i]) == 1:
+            return stems[i]
+        parent = os.path.basename(
+            os.path.dirname(os.path.abspath(args.stream[i]))
+        )
+        return f"{parent}/{stems[i]}" if parent else stems[i]
+
+    streams = []
+    for i, p in enumerate(args.stream):
+        try:
+            events, errors = report.load_events(p)
+        except OSError as e:
+            print(f"tpu-tlc: {e}", file=sys.stderr)
+            return 2
+        for e in errors:
+            print(f"tpu-tlc: {p}: WARNING: {e}", file=sys.stderr)
+        if not events:
+            print(f"tpu-tlc: {p}: no telemetry events", file=sys.stderr)
+            return 2
+        streams.append((label(i), events))
+    tr = trace.write_trace(streams, args.output)
+    n = sum(1 for e in tr["traceEvents"] if e.get("ph") != "M")
+    print(
+        f"wrote {args.output}: {n} event(s) from {len(streams)} "
+        "stream(s) — open in https://ui.perfetto.dev"
+    )
+    return 0
+
+
+def _cmd_metrics(args) -> int:
+    """Prometheus text metrics: scrape the daemon, or derive the same
+    families from a telemetry stream tail (--stream)."""
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+
+    if args.stream:
+        from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
+        from pulsar_tlaplus_tpu.obs import report
+
+        try:
+            events, errors = report.load_events(args.stream)
+        except OSError as e:
+            print(f"tpu-tlc: {e}", file=sys.stderr)
+            return 2
+        for e in errors:
+            print(
+                f"tpu-tlc: {args.stream}: WARNING: {e}", file=sys.stderr
+            )
+        sys.stdout.write(metrics_mod.render_stream_metrics(events))
+        return 0
+    cl = _service_client(args)
+    try:
+        sys.stdout.write(cl.metrics())
+    except (ServiceError, OSError) as e:
+        _client_die(f"metrics failed: {e}")
+    return 0
+
+
+def _cmd_top(args) -> int:
+    """Live ANSI dashboard: poll the daemon (default) or tail a
+    telemetry stream (--stream).  --once renders a single frame (no
+    clear codes) and exits — the scriptable/test mode."""
+    from pulsar_tlaplus_tpu.obs import top as top_mod
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+
+    if args.stream:
+        model = top_mod.TopModel(", ".join(args.stream))
+
+        def frame():
+            return top_mod.tail_stream_frame(args.stream, model)
+    else:
+        cl = _service_client(args)
+        model = top_mod.TopModel(_socket_of(args))
+
+        def frame():
+            return top_mod.poll_daemon_frame(cl, model)
+
+    try:
+        while True:
+            try:
+                text = frame()
+            except (ServiceError, OSError) as e:
+                _client_die(f"top failed: {e}")
+            if args.once:
+                print(text)
+                return 0
+            sys.stdout.write(top_mod.CLEAR + text + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
 def _cmd_cache(args) -> int:
     from pulsar_tlaplus_tpu.utils import aot_cache
 
@@ -912,6 +1017,57 @@ def main(argv=None):
     pca = sub.add_parser("cancel", help="cancel a queued/running job")
     pca.add_argument("job_id")
     _add_client_args(pca)
+
+    ptr = sub.add_parser(
+        "trace",
+        help="convert telemetry stream(s) into Perfetto-loadable "
+        "Chrome trace JSON: BFS levels, ckpt stalls, sweep chunks, "
+        "daemon job slices + context-switch gaps on one timeline",
+    )
+    ptr.add_argument(
+        "stream", nargs="+",
+        help="telemetry JSONL file(s): engine runs, a daemon's "
+        "service.jsonl, per-job jobs/<id>/events.jsonl — any mix",
+    )
+    ptr.add_argument(
+        "-o", "--output", default="trace.json",
+        help="output trace file (default trace.json)",
+    )
+
+    pm = sub.add_parser(
+        "metrics",
+        help="Prometheus text metrics: scrape the live daemon's "
+        "`metrics` verb, or derive the same families from a stream "
+        "tail (--stream)",
+    )
+    pm.add_argument(
+        "--stream", default=None, metavar="FILE",
+        help="derive metrics from this telemetry JSONL instead of "
+        "scraping the daemon",
+    )
+    _add_client_args(pm)
+
+    pt = sub.add_parser(
+        "top",
+        help="live dashboard: job table, per-job rate sparklines, "
+        "heartbeat status line — polling the daemon or tailing a "
+        "stream (--stream)",
+    )
+    pt.add_argument(
+        "--stream", action="append", default=None, metavar="FILE",
+        help="tail telemetry JSONL file(s) instead of polling the "
+        "daemon (repeatable: pass service.jsonl plus "
+        "jobs/*/events.jsonl for per-job sparklines)",
+    )
+    pt.add_argument(
+        "--interval", type=float, default=2.0, metavar="SEC",
+        help="refresh interval (default 2s)",
+    )
+    pt.add_argument(
+        "--once", action="store_true",
+        help="render one frame (no ANSI clear) and exit",
+    )
+    _add_client_args(pt)
 
     pch = sub.add_parser(
         "cache",
@@ -1120,6 +1276,9 @@ def main(argv=None):
             "watch": _cmd_watch,
             "cancel": _cmd_cancel,
             "cache": _cmd_cache,
+            "trace": _cmd_trace,
+            "metrics": _cmd_metrics,
+            "top": _cmd_top,
         }[args.cmd](args)
 
     args.xprof_window = None

@@ -69,12 +69,15 @@ BIG = jnp.int32(2**31 - 1)
 
 # Zero-sync device counters (round 8): the fpset metrics vector rides
 # the ONE hot-path stats fetch — [flushes, probe_rounds, failures,
-# valid_lanes, max_probe_rounds].  valid_lanes is the candidate count
-# after validity masking (the duplicate-rate denominator the host
-# cannot know without a sync); max_probe_rounds is the worst flush's
-# probe depth (a running max, not a sum).  Pre-r8 checkpoint frames
-# carry the 3-wide prefix and restore zero-padded.  Shared with the
-# sharded engine via ops/fpset.py (r9).
+# valid_lanes_lo, max_probe_rounds, valid_lanes_hi].  valid_lanes is
+# the candidate count after validity masking (the duplicate-rate
+# denominator the host cannot know without a sync), carried as hi/lo
+# uint32 words since r12 so it survives past 2.1G candidate lanes
+# (``fpset.fpm_update`` owns the carry, ``fpset.fpm_logical`` the
+# host-side 64-bit view); max_probe_rounds is the worst flush's probe
+# depth (a running max, not a sum).  Pre-widening checkpoint frames
+# carry the 3- or 5-wide prefix and restore zero-padded.  Shared with
+# the sharded engine via ops/fpset.py (r9).
 FPM_N = fpset.FPM_N
 
 # payload word: low 31 bits = accumulator slot index, bit 31 = the
@@ -345,7 +348,7 @@ class DeviceChecker:
         self._snap: Dict[str, object] = {}
         self._fetch_n = 0
         self._ckpt_write_s = 0.0
-        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._fpm_prev = np.zeros((fpset.FPM_LOGICAL_N,), np.int64)
         self._compact_prev = 0
         self._compact_prev_s = 0.0
         self._resume_meta: Dict[str, object] = {}
@@ -615,7 +618,8 @@ class DeviceChecker:
         slot-wins, so gid assignment is IDENTICAL to the legacy flush),
         feeding the unchanged append.  ``fpm`` accumulates the
         per-flush metrics [flushes, probe_rounds, failures,
-        valid_lanes, max_probe_rounds] on device (:data:`FPM_N`) so
+        valid_lanes_lo, max_probe_rounds, valid_lanes_hi] on device
+        (:data:`FPM_N`) so
         they ride the one hot-path stats fetch — zero extra syncs;
         failures (stage overflow / probe limit) surface at the next
         stats fetch as a hard error — states were dropped, the run
@@ -641,14 +645,11 @@ class DeviceChecker:
                 compact_impl=self.compact_impl,
             )
             n_new = jnp.sum(is_new.astype(jnp.int32))
-            fpm = jnp.stack(
-                [
-                    fpm[0] + 1,
-                    fpm[1] + rounds,
-                    fpm[2] + n_failed,
-                    fpm[3] + jnp.sum(valid.astype(jnp.int32)),
-                    jnp.maximum(fpm[4], rounds),
-                ]
+            # hi/lo carry arithmetic for the valid-lane words lives in
+            # the shared helper (r12 int32-wrap fix)
+            fpm = fpset.fpm_update(
+                fpm, rounds, n_failed,
+                jnp.sum(valid.astype(jnp.int32)),
             )
             return (*tc2, n_new, is_new.astype(jnp.uint32), fpm)
 
@@ -1009,14 +1010,9 @@ class DeviceChecker:
                         jnp.min(jnp.where(bad, gid_base + lane, BIG))
                     )
                 viol = jnp.minimum(viol, jnp.stack(vnew))
-            fpm = jnp.stack(
-                [
-                    fpm[0] + 1,
-                    fpm[1] + rounds,
-                    fpm[2] + n_failed,
-                    fpm[3] + jnp.sum(valid.astype(jnp.int32)),
-                    jnp.maximum(fpm[4], rounds),
-                ]
+            fpm = fpset.fpm_update(
+                fpm, rounds, n_failed,
+                jnp.sum(valid.astype(jnp.int32)),
             )
             return (
                 *tc2,
@@ -1571,7 +1567,7 @@ class DeviceChecker:
         self._ckpt_write_s = 0.0
         self._ckpt_retries = 0
         self._fetch_n = 0
-        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._fpm_prev = np.zeros((fpset.FPM_LOGICAL_N,), np.int64)
         # compact-event deltas baseline at THIS run's starting counter
         # values: the stage counters in last_stats are lifetime
         # cumulative, and a second run() on the same checker must not
@@ -1583,6 +1579,7 @@ class DeviceChecker:
             self.last_stats.get("stage_compact_s", 0.0)
         )
         self._resume_meta = {}
+        self._restore_s = 0.0  # frame-restore wall of THIS run (resume)
         self._xprof_on = False
         self._xprof_done = False
         # a crash mid-frame-write can leave a dead multi-GB tmp behind
@@ -1717,9 +1714,15 @@ class DeviceChecker:
                 raise ValueError("resume and seed are mutually exclusive")
             if not self.checkpoint_path:
                 raise ValueError("resume requires checkpoint_path")
+            t_restore = time.perf_counter()
             (
                 bufs, st, rb, level_sizes, level_base, nf, saved_wall,
             ) = self._restore_frame()
+            # the context-switch restore cost (frame load + device
+            # rebuild) — the serve bench's counterpart to the frame
+            # write stall; the scheduler reads it per resumed slice
+            self._restore_s = time.perf_counter() - t_restore
+            self.last_stats["restore_s"] = round(self._restore_s, 3)
             t0 = time.time() - saved_wall
             self.rec.arm()  # the on-disk frame is valid
             self._emit_header(resume=True)
@@ -1860,9 +1863,12 @@ class DeviceChecker:
             n_inv = len(self.invariant_names)
             self._last_fpm = out[2 + n_inv:]
             self._snap["occupancy"] = nv / max(self.TCAP, 1)
-            if len(self._last_fpm) >= FPM_N:
+            if len(self._last_fpm) >= 4:
                 # TLC's "states generated": candidate lanes examined
-                self._snap["generated"] = int(self._last_fpm[3])
+                # (64-bit reassembly of the hi/lo words, r12)
+                self._snap["generated"] = int(
+                    fpset.fpm_logical(self._last_fpm)[3]
+                )
             self._emit_flush_event(nv)
         self._emit_compact_event()
         if fpmode:
@@ -1885,7 +1891,9 @@ class DeviceChecker:
         counters) — per-flush visibility without per-flush syncs."""
         if not self.tel.enabled or self._last_fpm is None:
             return
-        cur = np.asarray(self._last_fpm[:FPM_N], np.int64)
+        # logical view: valid-lane hi/lo words reassembled to 64 bits,
+        # so the stream deltas stay honest past the int32 wrap (r12)
+        cur = fpset.fpm_logical(self._last_fpm)
         d = cur - self._fpm_prev
         if d[0] <= 0:
             return
@@ -1897,7 +1905,7 @@ class DeviceChecker:
             failures=int(d[2]),
             valid_lanes=int(d[3]),
             avg_probe_rounds=round(int(d[1]) / max(int(d[0]), 1), 2),
-            max_probe_rounds=int(cur[4]) if len(cur) > 4 else 0,
+            max_probe_rounds=int(cur[4]),
             occupancy=round(nv / max(self.TCAP, 1), 4),
             distinct_states=nv,
         )
@@ -1939,7 +1947,9 @@ class DeviceChecker:
             # synthetic stage overflow: account one dropped lane in
             # the device metrics — the next stats fetch fail-stops
             # exactly like a real probe overflow would
-            st["fpm"] = st["fpm"] + jnp.asarray([0, 0, 1, 0, 0], jnp.int32)
+            st["fpm"] = st["fpm"] + jnp.asarray(
+                [0, 0, 1] + [0] * (FPM_N - 3), jnp.int32
+            )
         if fpmode:
             out = self._stage_mark(
                 "flush",
@@ -2446,6 +2456,11 @@ class DeviceChecker:
             ckpt_bytes=self._ckpt_bytes,
             ckpt_write_s=round(self._ckpt_write_s, 3),
             ckpt_retries=self._ckpt_retries,
+            # the LAST frame's costs stand alone: when a slice suspends,
+            # this frame IS the suspend frame — the scheduler attaches
+            # these to the job_suspend event (context-switch write cost)
+            ckpt_last_write_s=round(write_s, 3),
+            ckpt_last_stall_s=round(stall_s, 3),
         )
         self.tel.emit(
             "ckpt_frame",
@@ -2568,15 +2583,16 @@ class DeviceChecker:
             "viol": jnp.full((n_inv,), int(BIG), jnp.int32),
         }
         if self.visited_impl == "fpset":
-            # pre-r8 frames carry the 3-wide fpm prefix; zero-pad the
-            # new counters (valid_lanes / max_probe_rounds restart)
+            # pre-widening frames carry the 3- or 5-wide fpm prefix;
+            # zero-pad the new counters (the r8 valid_lanes /
+            # max_probe_rounds and the r12 valid_lanes_hi word restart)
             old = np.asarray(d["fpm"], np.int32).reshape(-1)
             fpm = np.zeros((FPM_N,), np.int32)
             fpm[: min(len(old), FPM_N)] = old[:FPM_N]
             st["fpm"] = jnp.asarray(fpm)
             # flush telemetry deltas continue from the frame's counts,
             # not from zero (a resumed run must not re-report them)
-            self._fpm_prev = fpm.astype(np.int64)
+            self._fpm_prev = fpset.fpm_logical(fpm)
         if "hbm_recovered" in d:
             self.rec.hbm_recovered = max(
                 self.rec.hbm_recovered, int(d["hbm_recovered"])
@@ -2728,11 +2744,12 @@ class DeviceChecker:
                 fpset_table_cap=self.TCAP,
                 fpset_occupancy=round(nv / max(self.TCAP, 1), 4),
             )
-            if len(self._last_fpm) >= FPM_N:
+            if len(self._last_fpm) >= 5:
                 # zero-sync device counters (r8): candidate lanes after
-                # validity masking (duplicate-rate denominator) and the
-                # worst single flush's probe depth
-                vl = int(self._last_fpm[3])
+                # validity masking (duplicate-rate denominator — 64-bit
+                # hi/lo reassembly since r12, honest past 2.1G lanes)
+                # and the worst single flush's probe depth
+                vl = int(fpset.fpm_logical(self._last_fpm)[3])
                 self.last_stats.update(
                     fpset_valid_lanes=vl,
                     fpset_max_probe_rounds=int(self._last_fpm[4]),

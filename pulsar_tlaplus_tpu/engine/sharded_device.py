@@ -62,8 +62,10 @@ from pulsar_tlaplus_tpu.ref import pyeval
 BIG = jnp.int32(2**31 - 1)
 
 # per-shard zero-sync fpset metrics vector [flushes, probe_rounds,
-# failures, valid_lanes, max_probe_rounds] — widened 3 -> 5 in r9 to
-# match the single-chip engine (ops/fpset.py is the shared source)
+# failures, valid_lanes_lo, max_probe_rounds, valid_lanes_hi] —
+# widened 3 -> 5 in r9 to match the single-chip engine and 5 -> 6 in
+# r12 (hi/lo uint32 valid-lane words survive the int32 wrap;
+# ops/fpset.py is the shared source)
 FPM_N = fpset.FPM_N
 TAG_BIT = jnp.uint32(1 << 31)
 IDX_MASK = jnp.uint32((1 << 31) - 1)
@@ -430,7 +432,7 @@ class ShardedDeviceChecker:
         self._run_id: Optional[str] = None
         self._snap: Dict[str, object] = {}
         self._fetch_n = 0
-        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._fpm_prev = np.zeros((fpset.FPM_LOGICAL_N,), np.int64)
         self._compact_n = 0
         self._compact_prev = 0
         self._resume_meta: Dict[str, object] = {}
@@ -832,18 +834,14 @@ class ShardedDeviceChecker:
                 )
                 n_new_owner = jnp.sum(is_new.astype(jnp.int32))
                 flag_own = is_new.astype(jnp.uint32)
-                # 5-wide zero-sync metrics (r9, = device_bfs.FPM_N):
+                # zero-sync metrics (r9, = device_bfs.FPM_N):
                 # valid_lanes is the routed-candidate count after
-                # masking (duplicate-rate denominator); col 4 is the
-                # worst flush's probe depth (running max, not a sum)
-                fpm = jnp.stack(
-                    [
-                        fpm[0] + 1,
-                        fpm[1] + rounds,
-                        fpm[2] + n_failed,
-                        fpm[3] + jnp.sum(valid.astype(jnp.int32)),
-                        jnp.maximum(fpm[4], rounds),
-                    ]
+                # masking (duplicate-rate denominator; hi/lo uint32
+                # words since r12); col 4 is the worst flush's probe
+                # depth (running max, not a sum)
+                fpm = fpset.fpm_update(
+                    fpm, rounds, n_failed,
+                    jnp.sum(valid.astype(jnp.int32)),
                 )
             else:
                 ccols = tuple(
@@ -1617,7 +1615,7 @@ class ShardedDeviceChecker:
         # the device fpm counters restart at zero after a restore;
         # flush-telemetry deltas must restart with them or every
         # record until the old totals are re-exceeded is suppressed
-        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._fpm_prev = np.zeros((fpset.FPM_LOGICAL_N,), np.int64)
         return (
             bufs, st, [int(x) for x in d["level_sizes"]],
             d["lb"].astype(np.int64), d["nf"].astype(np.int64),
@@ -1870,7 +1868,7 @@ class ShardedDeviceChecker:
         self._ckpt_retries = 0
         self._bufs_poisoned = False
         self._flush_seq = 0
-        self._fpm_prev = np.zeros((FPM_N,), np.int64)
+        self._fpm_prev = np.zeros((fpset.FPM_LOGICAL_N,), np.int64)
         self._compact_n = 0
         self._compact_prev = 0
         self._resume_meta = {}
@@ -2104,8 +2102,12 @@ class ShardedDeviceChecker:
             )
             if self._last_fpm.shape[1] >= 4:
                 # TLC's "states generated": routed lanes examined
+                # (per-shard 64-bit reassembly before the mesh sum)
                 self._snap["generated"] = int(
-                    self._last_fpm[:, 3].sum()
+                    sum(
+                        fpset.fpm_logical(row)[3]
+                        for row in self._last_fpm
+                    )
                 )
             self._emit_flush_event(nv, out)
         self._emit_compact_event()
@@ -2127,7 +2129,12 @@ class ShardedDeviceChecker:
         per-flush visibility, zero extra syncs."""
         if not self.tel.enabled or self._last_fpm is None:
             return
-        per = np.asarray(self._last_fpm, np.int64)
+        # per-shard 64-bit reassembly FIRST (hi/lo valid-lane words,
+        # r12), THEN the mesh sum — summing lo words across shards
+        # would drop every shard-local carry
+        per = np.stack(
+            [fpset.fpm_logical(row) for row in self._last_fpm]
+        )
         cur = np.concatenate([per[:, :4].sum(axis=0), [per[:, 4].max()]])
         d = cur - self._fpm_prev
         if d[0] <= 0:
@@ -2660,12 +2667,18 @@ class ShardedDeviceChecker:
                     float(stats[:, 1].max()) / max(self.TCAP, 1), 4
                 ),
             )
-            if self._last_fpm.shape[1] >= FPM_N:
+            if self._last_fpm.shape[1] >= 5:
                 # zero-sync device counters (r9, = device_bfs): routed
                 # lanes after validity masking (duplicate-rate
-                # denominator) and the worst single flush's probe
-                # depth anywhere on the mesh
-                vl = int(self._last_fpm[:, 3].sum())
+                # denominator; per-shard hi/lo reassembly since r12)
+                # and the worst single flush's probe depth anywhere on
+                # the mesh
+                vl = int(
+                    sum(
+                        fpset.fpm_logical(row)[3]
+                        for row in self._last_fpm
+                    )
+                )
                 self.last_stats.update(
                     fpset_valid_lanes=vl,
                     fpset_max_probe_rounds=int(

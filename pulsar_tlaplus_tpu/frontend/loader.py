@@ -9,12 +9,30 @@ canonicalizes key/value spaces to ``1..n`` via :mod:`..utils.cfg`).
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Dict
 
 from pulsar_tlaplus_tpu.frontend import tla_ast as A
 from pulsar_tlaplus_tpu.frontend.interp import MV, Spec
 from pulsar_tlaplus_tpu.utils.cfg import TLCConfig
+
+def reference_spec_path(module: str = "compaction") -> str:
+    """Resolve a reference ``.tla`` module file: the vendored copy in
+    this repo's ``specs/`` wins, with ``/root/reference/`` (the original
+    retrieval mount, present only on some hosts) as the fallback.
+    Returns the first existing candidate — or the ``specs/`` path when
+    neither exists, so the caller's open() error names the path we
+    actually expect to ship."""
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    vendored = os.path.join(repo_root, "specs", f"{module}.tla")
+    for cand in (vendored, f"/root/reference/{module}.tla"):
+        if os.path.exists(cand):
+            return cand
+    return vendored
+
 
 COMPACTION_MODEL_VALUES = (
     "Nil",

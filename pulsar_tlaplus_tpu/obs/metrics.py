@@ -1,0 +1,389 @@
+"""Flight deck: Prometheus text-exposition metrics for daemon and runs.
+
+Two producers, ONE metric namespace (`ptt_*`), so dashboards never care
+whether the source was a live daemon or a stream file:
+
+- **daemon mode** — the service protocol's ``metrics`` verb
+  (``service/server.py _op_metrics``) renders from the scheduler's job
+  table and the pool's last-fetched engine stats.  Everything here is
+  host-side state the engines already maintain (``last_stats``, the
+  heartbeat snapshot dict, scheduler counters): a scrape adds **zero**
+  device stats fetches, which ``tests/test_flightdeck.py`` asserts with
+  the same fetch-count harness as the heartbeat tests.
+- **file-scrape mode** — :func:`stream_metrics` derives the same
+  families from a telemetry stream's tail (last ``level``/``flush``
+  records, event sums), so a solo ``-telemetry`` run exports the exact
+  same names via ``cli.py metrics --stream run.jsonl``.
+
+Exposition format: the Prometheus text format, one ``# HELP``/``# TYPE``
+pair per family.  :func:`parse_exposition` is the minimal inverse used
+by the tests and by ``cli.py top``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+# ------------------------------------------------------------ families
+
+
+class Family:
+    """One metric family: name, type, help, and labelled samples."""
+
+    def __init__(self, name: str, kind: str, help_: str):
+        self.name = name
+        self.kind = kind  # "gauge" | "counter"
+        self.help = help_
+        self.samples: List[Tuple[Dict[str, str], float]] = []
+
+    def add(self, value, labels: Optional[Dict[str, str]] = None):
+        if value is None:
+            return self
+        self.samples.append((dict(labels or {}), float(value)))
+        return self
+
+
+def render_exposition(families: List[Family]) -> str:
+    """Families -> Prometheus text exposition (families with no
+    samples are skipped — absent beats a fabricated zero)."""
+    lines: List[str] = []
+    for f in families:
+        if not f.samples:
+            continue
+        lines.append(f"# HELP {f.name} {f.help}")
+        lines.append(f"# TYPE {f.name} {f.kind}")
+        for labels, value in f.samples:
+            lab = ""
+            if labels:
+                inner = ",".join(
+                    f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+                )
+                lab = "{" + inner + "}"
+            if value == int(value):
+                lines.append(f"{f.name}{lab} {int(value)}")
+            else:
+                lines.append(f"{f.name}{lab} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def parse_exposition(text: str):
+    """Prometheus text -> {name: [(labels, value)]}, plus the TYPE map
+    — the minimal scrape parser ``top`` and the tests use."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _h, _t, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            key, val_s = line.rsplit(None, 1)
+            value = float(val_s)
+        except ValueError:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        labels: Dict[str, str] = {}
+        name = key
+        if "{" in key:
+            name, rest = key.split("{", 1)
+            if not rest.endswith("}"):
+                raise ValueError(f"unbalanced labels: {line!r}")
+            body = rest[:-1]
+            if body:
+                for part in body.split(","):
+                    k, v = part.split("=", 1)
+                    v = v.strip('"')
+                    labels[k] = (
+                        v.replace('\\"', '"').replace("\\\\", "\\")
+                    )
+        out.setdefault(name, []).append((labels, value))
+    return out, types
+
+
+# ----------------------------------------------- shared engine families
+
+
+def _engine_families(
+    stats: Dict[str, object], snap: Dict[str, object]
+) -> List[Family]:
+    """The engine-health families BOTH modes emit, from a last-stats
+    dict + heartbeat-style snapshot (either live objects or their
+    stream-derived equivalents)."""
+    f_distinct = Family(
+        "ptt_distinct_states", "gauge",
+        "Distinct states found by the focal run",
+    ).add(snap.get("distinct_states"))
+    f_rate = Family(
+        "ptt_states_per_sec", "gauge",
+        "Recent distinct-state discovery rate",
+    ).add(snap.get("states_per_sec"))
+    f_level = Family(
+        "ptt_bfs_level", "gauge", "Current BFS level (search depth)"
+    ).add(snap.get("level"))
+    f_frontier = Family(
+        "ptt_frontier_states", "gauge", "Current BFS frontier size"
+    ).add(snap.get("frontier"))
+    f_occ = Family(
+        "ptt_fpset_occupancy", "gauge",
+        "Visited-set hash table load factor",
+    ).add(snap.get("occupancy"))
+    f_probe = Family(
+        "ptt_fpset_max_probe_rounds", "gauge",
+        "Worst single flush's probe depth (schedule tuning signal)",
+    ).add(stats.get("fpset_max_probe_rounds"))
+    f_lanes = Family(
+        "ptt_fpset_valid_lanes_total", "counter",
+        "Candidate lanes examined (duplicate-rate denominator)",
+    ).add(stats.get("fpset_valid_lanes"))
+    f_flushes = Family(
+        "ptt_fpset_flushes_total", "counter",
+        "Visited-set flush dispatches",
+    ).add(stats.get("fpset_flushes"))
+    f_hbm = Family(
+        "ptt_hbm_recoveries_total", "counter",
+        "Device-memory exhaustion recoveries",
+    ).add(stats.get("hbm_recovered"))
+    f_frames = Family(
+        "ptt_ckpt_frames_total", "counter",
+        "Checkpoint frames written",
+    ).add(stats.get("ckpt_frames"))
+    f_stall = Family(
+        "ptt_ckpt_stall_seconds_total", "counter",
+        "Run-loop seconds blocked on checkpoint frame writes",
+    ).add(stats.get("ckpt_write_s"))
+    f_fetches = Family(
+        "ptt_stats_fetches_total", "counter",
+        "Hot-path device stats fetches (the one engine sync)",
+    ).add(stats.get("stats_fetches"))
+    return [
+        f_distinct, f_rate, f_level, f_frontier, f_occ, f_probe,
+        f_lanes, f_flushes, f_hbm, f_frames, f_stall, f_fetches,
+    ]
+
+
+# ------------------------------------------------------- daemon scrape
+
+
+def scheduler_metrics(
+    sched, uptime_s: Optional[float] = None,
+    warmed: Optional[list] = None,
+) -> List[Family]:
+    """Metric families from a live Scheduler — scheduler/job-table
+    state plus the most recent slice's engine stats
+    (``sched.last_engine``) and, while a job runs, the live heartbeat
+    snapshot of the active checker.  Reads ONLY host-side dicts: a
+    scrape never touches the device (asserted fetch-count-identical in
+    tests)."""
+    from pulsar_tlaplus_tpu.utils import aot_cache
+
+    with sched.cv:
+        jobs = list(sched.jobs.values())
+        running_id = sched._running_id
+        queue_depth = len(sched.fifo)
+    counts: Dict[str, int] = {}
+    for j in jobs:
+        counts[j.state] = counts.get(j.state, 0) + 1
+
+    f_up = Family(
+        "ptt_daemon_up", "gauge", "1 while the daemon answers"
+    ).add(1)
+    f_uptime = Family(
+        "ptt_daemon_uptime_seconds", "gauge", "Daemon uptime"
+    ).add(uptime_s)
+    f_jobs = Family(
+        "ptt_jobs", "gauge", "Jobs in the table by lifecycle state"
+    )
+    from pulsar_tlaplus_tpu.service import jobs as jobmod
+
+    for state in jobmod.STATES:
+        f_jobs.add(counts.get(state, 0), {"state": state})
+    f_queue = Family(
+        "ptt_queue_depth", "gauge", "Jobs waiting in the FIFO"
+    ).add(queue_depth)
+    f_active = Family(
+        "ptt_active_job", "gauge",
+        "1 when a job holds the device (job_id/spec labels)",
+    )
+    active = next(
+        (j for j in jobs if j.job_id == running_id), None
+    )
+    if active is not None:
+        f_active.add(1, {"job_id": active.job_id, "spec": active.spec})
+    else:
+        f_active.add(0)
+    f_slices = Family(
+        "ptt_job_slices_total", "counter",
+        "Scheduling slices run across all jobs in the table",
+    ).add(sum(j.slices for j in jobs))
+    f_susp = Family(
+        "ptt_job_suspends_total", "counter",
+        "Frame-boundary suspensions across all jobs in the table",
+    ).add(sum(j.suspends for j in jobs))
+    f_warm = Family(
+        "ptt_warmed_specs", "gauge",
+        "Registry specs with warmed executables",
+    ).add(len(warmed) if warmed is not None else None)
+    try:
+        cache = aot_cache.stats()
+        f_cache = Family(
+            "ptt_aot_cache_bytes", "gauge",
+            "AOT executable cache size on disk",
+        ).add(cache["bytes"])
+        f_centries = Family(
+            "ptt_aot_cache_entries", "gauge",
+            "AOT executable cache entry count",
+        ).add(cache["entries"])
+    except OSError:  # cache dir unreadable: skip, don't fail the scrape
+        f_cache = Family("ptt_aot_cache_bytes", "gauge", "unavailable")
+        f_centries = Family(
+            "ptt_aot_cache_entries", "gauge", "unavailable"
+        )
+
+    last = getattr(sched, "last_engine", None) or {}
+    stats = dict(last.get("stats") or {})
+    snap = dict(last.get("snap") or {})
+    ck = getattr(sched, "_active_ck", None)
+    if active is not None and ck is not None:
+        # live heartbeat snapshot of the running job's engine — the
+        # same host dict the Heartbeat thread reads, zero syncs.  The
+        # engine thread inserts NEW keys into it at stats fetches, so
+        # copying can race a resize; retry-or-skip rather than failing
+        # the scrape (the data is best-effort by construction)
+        for _attempt in range(3):
+            try:
+                snap.update(dict(getattr(ck, "_snap", {}) or {}))
+                break
+            except RuntimeError:
+                continue
+    if "states_per_sec" not in snap and last.get("states_per_sec"):
+        snap["states_per_sec"] = last["states_per_sec"]
+    return [
+        f_up, f_uptime, f_jobs, f_queue, f_active, f_slices, f_susp,
+        f_warm, f_cache, f_centries,
+    ] + _engine_families(stats, snap)
+
+
+# -------------------------------------------------------- file scrape
+
+
+def stream_metrics(events: List[dict]) -> List[Family]:
+    """The same families derived from a telemetry stream's tail —
+    identically NAMED whether the stream came from a daemon
+    (``service.jsonl``: job families too) or a solo engine run."""
+    stats: Dict[str, object] = {}
+    snap: Dict[str, object] = {}
+    last_level = None
+    occupancy = None
+    max_probe = 0
+    lanes = flushes = frames = 0
+    stall = 0.0
+    hbm = 0
+    for e in events:
+        ev = e.get("event")
+        if ev == "level":
+            last_level = e
+        elif ev == "progress":
+            # newest heartbeat wins (overwritten by the last level
+            # record below, when the stream has any): keeping a stale
+            # first snapshot beside a fresh rate would render a live
+            # run as frozen
+            snap["distinct_states"] = e.get("distinct_states")
+            snap["states_per_sec"] = e.get("states_per_sec")
+        elif ev == "flush":
+            flushes += int(e.get("flushes", 0))
+            lanes += int(e.get("valid_lanes", 0))
+            max_probe = max(max_probe, int(e.get("max_probe_rounds", 0)))
+            if e.get("occupancy") is not None:
+                occupancy = e["occupancy"]
+        elif ev == "ckpt_frame":
+            frames += 1
+            stall += float(e.get("stall_s", e.get("write_s", 0.0)) or 0)
+        elif ev == "hbm_recovery":
+            hbm += 1
+        elif ev == "result":
+            rstats = e.get("stats") or {}
+            if isinstance(rstats, dict):
+                stats.update(rstats)
+            snap["distinct_states"] = e.get("distinct_states")
+    if last_level is not None:
+        snap["distinct_states"] = last_level.get("distinct_states")
+        snap["states_per_sec"] = last_level.get("states_per_sec")
+        snap["level"] = last_level.get("level")
+        snap["frontier"] = last_level.get("frontier")
+    if occupancy is not None:
+        snap.setdefault("occupancy", occupancy)
+    stats.setdefault("fpset_valid_lanes", lanes or None)
+    stats.setdefault("fpset_flushes", flushes or None)
+    stats.setdefault("fpset_max_probe_rounds", max_probe or None)
+    stats.setdefault("ckpt_frames", frames or None)
+    stats.setdefault("ckpt_write_s", round(stall, 3) if frames else None)
+    stats.setdefault("hbm_recovered", hbm or None)
+
+    fams = _engine_families(stats, snap)
+
+    # daemon streams additionally carry the job lifecycle
+    from pulsar_tlaplus_tpu.obs import report
+    from pulsar_tlaplus_tpu.service import jobs as jobmod
+
+    rows = report.job_table(events)
+    if rows:
+        # reconstruct the same LIFECYCLE states the live daemon labels
+        # ptt_jobs with (jobmod.STATES) — a dashboard query on
+        # {state="running"} must read identically from either source
+        last_lifecycle: Dict[str, str] = {}
+        for e in events:
+            jid = e.get("job_id")
+            ev = e.get("event", "")
+            if jid is None:
+                continue
+            if ev == "job_submit":
+                last_lifecycle.setdefault(jid, jobmod.QUEUED)
+            elif ev in ("job_start", "job_resume"):
+                last_lifecycle[jid] = jobmod.RUNNING
+            elif ev == "job_suspend":
+                last_lifecycle[jid] = jobmod.SUSPENDED
+        counts: Dict[str, int] = {}
+        for r in rows:
+            if r.get("cancelled"):
+                state = jobmod.CANCELLED
+            elif r.get("status") is None:
+                state = last_lifecycle.get(r["job_id"], jobmod.QUEUED)
+            elif r["status"] in ("ok", "violation", "deadlock",
+                                 "truncated"):
+                state = jobmod.DONE
+            elif r["status"] in jobmod.STATES:
+                state = str(r["status"])
+            else:
+                state = jobmod.DONE
+            counts[state] = counts.get(state, 0) + 1
+        f_jobs = Family(
+            "ptt_jobs", "gauge", "Jobs in the stream by lifecycle state"
+        )
+        for state in jobmod.STATES:
+            f_jobs.add(counts.get(state, 0), {"state": state})
+        fams.append(f_jobs)
+        fams.append(
+            Family(
+                "ptt_job_slices_total", "counter",
+                "Scheduling slices run across all jobs in the stream",
+            ).add(sum(int(r["slices"]) for r in rows))
+        )
+        fams.append(
+            Family(
+                "ptt_job_suspends_total", "counter",
+                "Frame-boundary suspensions across all jobs",
+            ).add(sum(int(r["suspends"]) for r in rows))
+        )
+    return fams
+
+
+def render_stream_metrics(events: List[dict]) -> str:
+    return render_exposition(stream_metrics(events))

@@ -240,9 +240,13 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
 
 def job_table(events: List[dict]) -> List[Dict[str, object]]:
     """Per-job lifecycle rows from a daemon stream's ``job_*`` events
-    (schema v4, docs/service.md): one row per job_id in submission
+    (schema v4+, docs/service.md): one row per job_id in submission
     order — spec, slices run, suspensions (mesh time-slice handoffs),
-    and the terminal status (``None`` while still in flight)."""
+    the terminal status (``None`` while still in flight), and (v5
+    streams) the measured context-switch costs: cumulative suspend
+    frame write/stall seconds, cumulative resume restore seconds, and
+    the engine wall the slices actually delivered — the real-chip
+    serve bench reads suspend/resume overhead straight from here."""
     jobs: Dict[str, Dict[str, object]] = {}
     for e in events:
         ev = e.get("event", "")
@@ -256,8 +260,16 @@ def job_table(events: List[dict]) -> List[Dict[str, object]]:
             {
                 "job_id": jid, "spec": None, "slices": 0,
                 "suspends": 0, "status": None, "cancelled": False,
+                "resumes": 0, "restore_s": 0.0, "frame_write_s": 0.0,
+                "frame_stall_s": 0.0, "slice_wall_s": 0.0,
+                "run_ids": [],
             },
         )
+        if e.get("engine_run_id"):
+            # the slice's engine run id (r12): the join key into the
+            # job's own events.jsonl stream
+            if e["engine_run_id"] not in row["run_ids"]:
+                row["run_ids"].append(e["engine_run_id"])
         if ev == "job_submit":
             row["spec"] = e.get("spec", row["spec"])
         elif ev in ("job_start", "job_resume"):
@@ -265,27 +277,63 @@ def job_table(events: List[dict]) -> List[Dict[str, object]]:
             row["slices"] = max(
                 int(row["slices"]), int(e.get("slice", 0))
             )
+            if ev == "job_resume":
+                row["resumes"] = int(row["resumes"]) + 1
+                if isinstance(e.get("restore_s"), (int, float)):
+                    row["restore_s"] = round(
+                        float(row["restore_s"]) + float(e["restore_s"]),
+                        3,
+                    )
         elif ev == "job_suspend":
             row["suspends"] = int(row["suspends"]) + 1
+            for k in ("frame_write_s", "frame_stall_s", "slice_wall_s"):
+                if isinstance(e.get(k), (int, float)):
+                    row[k] = round(float(row[k]) + float(e[k]), 3)
         elif ev == "job_result":
             row["status"] = e.get("status")
+            if isinstance(e.get("wall_s"), (int, float)):
+                # total engine wall across all slices (r12) — includes
+                # the final slice that slice_wall_s sums can't see
+                row["wall_s"] = float(e["wall_s"])
         elif ev == "job_cancel":
             row["cancelled"] = True
     return list(jobs.values())
 
 
 def render_job_table(events: List[dict]) -> str:
-    """Markdown view of :func:`job_table` for a daemon stream."""
+    """Markdown view of :func:`job_table` for a daemon stream.  The
+    overhead columns are per-transition averages: frame write+stall
+    seconds per suspend and restore seconds per resume (the two halves
+    of one mesh context switch), rendered "—" for pre-v5 streams that
+    never measured them."""
     rows = job_table(events)
     if not rows:
         return "(no job_* events in this stream)"
     lines = [
-        "| job | spec | slices | suspends | status |",
-        "|---|---|---|---|---|",
+        "| job | spec | slices | suspends | wall s "
+        "| susp s (write+stall) | restore s | status |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
+        n_susp = int(r["suspends"])
+        n_res = int(r["resumes"])
+        susp = (
+            f"{(r['frame_write_s'] + r['frame_stall_s']) / n_susp:.3f}"
+            if n_susp and (r["frame_write_s"] or r["frame_stall_s"])
+            else "—"
+        )
+        rest = (
+            f"{r['restore_s'] / n_res:.3f}"
+            if n_res and r["restore_s"]
+            else "—"
+        )
+        # total wall from job_result when the stream carries it; the
+        # suspended-slices sum is only a lower bound (no final slice)
+        total_wall = r.get("wall_s") or r["slice_wall_s"]
+        wall = f"{total_wall:.2f}" if total_wall else "—"
         lines.append(
             f"| {r['job_id']} | {r['spec'] or '?'} | {r['slices']} "
-            f"| {r['suspends']} | {r['status'] or 'in flight'} |"
+            f"| {r['suspends']} | {wall} | {susp} | {rest} "
+            f"| {r['status'] or 'in flight'} |"
         )
     return "\n".join(lines)

@@ -51,10 +51,17 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # job engine streams are unchanged, but a stream may now legitimately
 # interleave several run_ids (one per scheduling slice / daemon
 # restart) — the validator additionally requires per-run_id strictly
-# increasing ``seq``.  Validators accept <= SCHEMA_VERSION and hold a
-# record only to the fields its OWN version requires (FIELD_SINCE) —
-# pre-r10 streams stay valid.
-SCHEMA_VERSION = 4
+# increasing ``seq``.  v5 (round 12, the flight deck): the daemon's
+# ``job_suspend`` records carry ``slice_wall_s`` (the suspended slice's
+# engine wall — the mesh time-slice length actually delivered) and
+# ``job_resume`` records carry ``restore_s`` (run-start to the first
+# level boundary of the resumed slice: frame load + device rebuild =
+# the context-switch restore cost the ROADMAP serve bench asks for);
+# ``obs/trace.py`` renders suspend->resume gaps as explicit
+# "context-switch" spans from exactly these fields.  Validators accept
+# <= SCHEMA_VERSION and hold a record only to the fields its OWN
+# version requires (FIELD_SINCE) — pre-r10 streams stay valid.
+SCHEMA_VERSION = 5
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -80,6 +87,11 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("job_resume", "slice"): 4,
     ("job_suspend", "job_id"): 4,
     ("job_suspend", "slice"): 4,
+    # v5: the context-switch cost breakdown (docs/observability.md
+    # "Flight deck") — required only at v5 so every existing v4 daemon
+    # stream stays validator-clean
+    ("job_suspend", "slice_wall_s"): 5,
+    ("job_resume", "restore_s"): 5,
     ("job_result", "job_id"): 4,
     ("job_result", "status"): 4,
     ("job_cancel", "job_id"): 4,
@@ -120,8 +132,8 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # slice's engine run_id (docs/service.md)
     "job_submit": ("job_id", "spec"),
     "job_start": ("job_id", "spec", "slice"),
-    "job_resume": ("job_id", "spec", "slice"),
-    "job_suspend": ("job_id", "slice"),
+    "job_resume": ("job_id", "spec", "slice", "restore_s"),
+    "job_suspend": ("job_id", "slice", "slice_wall_s"),
     "job_result": ("job_id", "status"),
     "job_cancel": ("job_id",),
     # daemon lifecycle: start (socket, pid, warmed specs) / stop

@@ -62,14 +62,67 @@ from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, _fmix
 
 # Width of the zero-sync device metrics vector engines accumulate next
 # to the table and ride on their ONE hot-path stats fetch: [flushes,
-# probe_rounds, failures, valid_lanes, max_probe_rounds].  valid_lanes
-# is the candidate count after validity masking (the duplicate-rate
-# denominator the host cannot know without a sync); max_probe_rounds is
-# the worst flush's probe depth (a running max, not a sum) — together
-# the probe-schedule tuning signal for DENSE_ROUNDS/STAGES below.
-# Shared by device_bfs and sharded_device (r9: the sharded fpm widened
-# 3 -> 5 to match); pre-widening checkpoint frames restore zero-padded.
-FPM_N = 5
+# probe_rounds, failures, valid_lanes_lo, max_probe_rounds,
+# valid_lanes_hi].  valid_lanes is the candidate count after validity
+# masking (the duplicate-rate denominator the host cannot know without
+# a sync); it is the one counter that genuinely outgrows int32 — a
+# 1B-state run examines far more than 2.1G candidate lanes — so it is
+# carried as hi/lo uint32 WORDS (r12; lo at the historical index 3,
+# the hi carry word appended at index 5 so every older index keeps its
+# meaning and pre-widening checkpoint frames restore zero-padded, the
+# same pattern as the r8/r9 widenings).  :func:`fpm_update` owns the
+# device-side carry arithmetic and :func:`fpm_logical` the host-side
+# 64-bit reassembly.  max_probe_rounds is the worst flush's probe
+# depth (a running max, not a sum) — with avg probes the
+# probe-schedule tuning signal for DENSE_ROUNDS/STAGES below.  Shared
+# by device_bfs and sharded_device.
+FPM_N = 6
+
+# length of the host-side LOGICAL view: [flushes, probe_rounds,
+# failures, valid_lanes (64-bit), max_probe_rounds]
+FPM_LOGICAL_N = 5
+
+
+def fpm_update(fpm, rounds, n_failed, n_valid):
+    """One flush's device-side metrics update (jit-traceable).
+
+    ``fpm`` is the int32[FPM_N] vector; ``n_valid`` (int32, < 2^31 per
+    flush) accumulates into the valid-lane LO word with uint32 wraparound
+    and the carry lands in the HI word — int32 storage holds the uint32
+    bit patterns (bitcast, never a value conversion), so 1B-state runs
+    report honest duplicate ratios instead of a wrapped counter."""
+    lo = lax.bitcast_convert_type(fpm[3], jnp.uint32)
+    new_lo = lo + n_valid.astype(jnp.uint32)
+    carry = (new_lo < lo).astype(jnp.int32)
+    return jnp.stack(
+        [
+            fpm[0] + 1,
+            fpm[1] + rounds,
+            fpm[2] + n_failed,
+            lax.bitcast_convert_type(new_lo, jnp.int32),
+            jnp.maximum(fpm[4], rounds),
+            fpm[5] + carry,
+        ]
+    )
+
+
+def fpm_logical(vec):
+    """int64[FPM_LOGICAL_N] logical view of a fetched fpm vector:
+    [flushes, probe_rounds, failures, valid_lanes, max_probe_rounds]
+    with the hi/lo valid-lane words reassembled into one 64-bit count.
+    Accepts the historical widths too (3-wide pre-r8, 5-wide r9-r11
+    frames restore zero-padded): a missing hi word reads as 0 and a
+    5-wide vector's index-3 int32 reinterprets as the lo uint32 word —
+    identical for every pre-wrap value."""
+    import numpy as np
+
+    a = np.asarray(vec, np.int64).reshape(-1)
+    v = np.zeros((FPM_N,), np.int64)
+    v[: min(len(a), FPM_N)] = a[:FPM_N]
+    lo = np.int64(np.uint32(v[3] & 0xFFFFFFFF))
+    return np.array(
+        [v[0], v[1], v[2], (v[5] << 32) | lo, v[4]], np.int64
+    )
 
 MAX_PROBES = 64
 # staged-compaction schedule for the engine hot path: a few dense

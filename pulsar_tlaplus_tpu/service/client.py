@@ -70,6 +70,11 @@ class ServiceClient:
     def cancel(self, job_id: str) -> str:
         return self._request("cancel", job_id=job_id)["state"]
 
+    def metrics(self) -> str:
+        """Prometheus text exposition of live daemon state (the r12
+        ``metrics`` verb; zero device syncs server-side)."""
+        return self._request("metrics")["metrics"]
+
     def shutdown(self) -> dict:
         return self._request("shutdown")
 

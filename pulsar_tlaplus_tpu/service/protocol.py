@@ -24,9 +24,13 @@ import os
 import socket
 from typing import Iterator, Optional
 
-# requests the daemon understands (server.py dispatch table)
+# requests the daemon understands (server.py dispatch table).
+# ``metrics`` (r12) answers a Prometheus text exposition rendered from
+# scheduler state + last-fetched engine stats — a scrape never adds a
+# device sync (docs/observability.md "Flight deck").
 OPS = (
-    "ping", "submit", "status", "result", "cancel", "watch", "shutdown",
+    "ping", "submit", "status", "result", "cancel", "watch",
+    "metrics", "shutdown",
 )
 
 # one message must fit memory comfortably; traces are bounded by spec

@@ -243,6 +243,12 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._running_id: Optional[str] = None
+        # flight-deck state (r12): the most recent slice's engine stats
+        # + heartbeat snapshot, and the checker actively holding the
+        # device — the `metrics` verb renders from exactly these
+        # host-side dicts, never a device fetch
+        self.last_engine: Optional[dict] = None
+        self._active_ck = None
         os.makedirs(config.jobs_dir, exist_ok=True)
 
     # ---------------------------------------------------- persistence
@@ -405,7 +411,13 @@ class Scheduler:
             self.fifo.append(jid)
             self.cv.notify_all()
         self.persist()
-        self.tel.emit("job_submit", job_id=jid, spec=spec)
+        # wall_unix anchors this stream's clock for obs/trace.py (the
+        # daemon stream has no run_header; the first anchored record
+        # fixes the run_id's offset on the shared wall timeline)
+        self.tel.emit(
+            "job_submit", job_id=jid, spec=spec,
+            wall_unix=round(time.time(), 3),
+        )
         self._log(f"job {jid}: submitted ({spec} @ {cfg_path})")
         return job
 
@@ -505,13 +517,39 @@ class Scheduler:
         with self.cv:
             return bool(self.fifo)
 
-    def _mk_hook(self, job: Job, deadline: Optional[float]):
+    def _mk_hook(
+        self, job: Job, deadline: Optional[float],
+        resume: bool = False, ck=None,
+    ):
         """The engine's cooperative suspend hook, polled at level
         boundaries: daemon shutdown and slice expiry suspend (frame +
-        requeue); a cancel request discards the run."""
+        requeue); a cancel request discards the run.
+
+        On a RESUMED slice the first poll additionally emits the
+        ``job_resume`` event: it fires right after the engine finished
+        rebuilding from the frame (the poll precedes any expansion), so
+        the record can carry the measured ``restore_s`` — the schema-v5
+        context-switch restore cost (the pre-run emission point of r11
+        could not know it yet)."""
         polls = [0]
+        t_slice = time.monotonic()
 
         def hook() -> Optional[str]:
+            polls[0] += 1
+            if polls[0] == 1 and resume:
+                restore_s = None
+                if ck is not None:
+                    restore_s = (ck.last_stats or {}).get("restore_s")
+                if restore_s is None:
+                    # engine didn't report: the wall from run() start
+                    # to this first boundary IS the restore+setup cost
+                    restore_s = round(time.monotonic() - t_slice, 3)
+                hook.resume_emitted = True
+                self.tel.emit(
+                    "job_resume",
+                    job_id=job.job_id, spec=job.spec,
+                    slice=job.slices, restore_s=float(restore_s),
+                )
             if job.cancel_requested:
                 return "cancelled"
             if self._stop.is_set():
@@ -521,7 +559,6 @@ class Scheduler:
             # suspend there (slice budget < frame-restore cost) would
             # ping-pong two jobs forever at zero states/slice.  Every
             # slice therefore advances >= one level before yielding.
-            polls[0] += 1
             if polls[0] == 1:
                 return None
             if (
@@ -532,6 +569,7 @@ class Scheduler:
                 return "suspended"
             return None
 
+        hook.resume_emitted = False
         return hook
 
     def _run_slice(self, job: Job) -> None:
@@ -565,10 +603,14 @@ class Scheduler:
             if remaining <= 0:
                 self._complete(job, None, budget_exhausted=True)
                 return
-        self.tel.emit(
-            "job_resume" if resume else "job_start",
-            job_id=job.job_id, spec=job.spec, slice=job.slices,
-        )
+        if not resume:
+            # fresh slices announce up front; RESUMED slices announce
+            # from the hook's first level-boundary poll instead, where
+            # the measured restore_s is known (schema v5 — _mk_hook)
+            self.tel.emit(
+                "job_start",
+                job_id=job.job_id, spec=job.spec, slice=job.slices,
+            )
         self._log(
             f"job {job.job_id}: slice {job.slices} "
             f"({'resume' if resume else 'start'})"
@@ -581,9 +623,13 @@ class Scheduler:
         ck.checkpoint_every = self.config.checkpoint_every
         ck._telemetry_arg = job.events_path
         ck.time_budget_s = remaining
-        ck.suspend_hook = self._mk_hook(
-            job, time.monotonic() + self.config.slice_s
+        prev_wall = float(job.wall_s)
+        hook = self._mk_hook(
+            job, time.monotonic() + self.config.slice_s,
+            resume=resume, ck=ck,
         )
+        ck.suspend_hook = hook
+        self._active_ck = ck
         try:
             r = ck.run(resume=resume)
         except Exception as e:  # noqa: BLE001
@@ -591,11 +637,34 @@ class Scheduler:
             return
         finally:
             ck.suspend_hook = None
+            self._active_ck = None
+            # the metrics verb answers from this after the slice ends —
+            # plain host dict copies, no device access
+            self.last_engine = {
+                "job_id": job.job_id,
+                "spec": job.spec,
+                "stats": dict(getattr(ck, "last_stats", {}) or {}),
+                "snap": dict(getattr(ck, "_snap", {}) or {}),
+            }
             # drop the run's device buffers: a suspended job's state
             # is its frame on disk, and the next job needs the HBM
             ck.last_bufs = None
         if ck._run_id:
             job.run_ids.append(ck._run_id)
+        if resume and not hook.resume_emitted:
+            # the slice ended before its first level-boundary poll
+            # (e.g. a time budget smaller than the restore cost): the
+            # restore was still PAID, and losing its record would hide
+            # exactly the pathological context switch worth seeing —
+            # emit the resume now, before the suspend/result record,
+            # so stream order stays resume < terminal
+            self.tel.emit(
+                "job_resume",
+                job_id=job.job_id, spec=job.spec, slice=job.slices,
+                restore_s=float(
+                    (ck.last_stats or {}).get("restore_s") or 0.0
+                ),
+            )
         job.wall_s = float(r.wall_s)
         if r.stop_reason == "suspended":
             job.suspends += 1
@@ -610,8 +679,28 @@ class Scheduler:
                 self.fifo.append(job.job_id)
                 self.cv.notify_all()
             self.persist()
+            # v5: the engine wall this slice actually delivered, plus
+            # the suspend frame's write/stall cost (the LAST frame of
+            # the slice IS the suspend frame) — with job_resume's
+            # restore_s these price the whole context switch
+            suspend_extra = {
+                "slice_wall_s": round(
+                    max(float(r.wall_s) - prev_wall, 0.0), 3
+                ),
+            }
+            ls = getattr(ck, "last_stats", {}) or {}
+            if "ckpt_last_write_s" in ls:
+                suspend_extra["frame_write_s"] = ls["ckpt_last_write_s"]
+            if "ckpt_last_stall_s" in ls:
+                suspend_extra["frame_stall_s"] = ls["ckpt_last_stall_s"]
+            if ck._run_id:
+                # the slice's ENGINE run id (the envelope run_id is
+                # the daemon's): lets consumers join this event to the
+                # per-job stream's level records — top's sparklines
+                suspend_extra["engine_run_id"] = ck._run_id
             self.tel.emit(
-                "job_suspend", job_id=job.job_id, slice=job.slices
+                "job_suspend", job_id=job.job_id, slice=job.slices,
+                **suspend_extra,
             )
             self._log(
                 f"job {job.job_id}: suspended at a frame boundary "
@@ -721,6 +810,18 @@ class Scheduler:
                 job.result.get("status", state)
                 if job.result
                 else state
+            ),
+            # cumulative engine wall across ALL slices (the final,
+            # never-suspended slice included) — the --jobs overhead
+            # table's denominator; slice_wall_s sums only cover the
+            # suspended slices
+            wall_s=round(float(job.wall_s), 3),
+            # the final slice's engine run id (join key into the
+            # per-job stream, like job_suspend.engine_run_id)
+            **(
+                {"engine_run_id": job.run_ids[-1]}
+                if job.run_ids
+                else {}
             ),
         )
         if state == jobmod.CANCELLED:

@@ -136,6 +136,10 @@ class ServiceDaemon:
             socket=self.config.socket_path,
             pid=os.getpid(),
             warmed=list(self.warmed),
+            # wall-clock anchor for this stream's run_id: obs/trace.py
+            # aligns the daemon's monotonic t axis against per-job
+            # engine streams through it
+            wall_unix=round(time.time(), 3),
         )
         try:
             os.remove(self.config.socket_path)
@@ -394,6 +398,23 @@ class ServiceDaemon:
                 return
             if not emitted:
                 time.sleep(WATCH_POLL_S)
+
+    def _op_metrics(self, req, w) -> None:
+        """Prometheus text exposition of live daemon + engine state —
+        rendered from the scheduler's job table, the pooled checkers'
+        ``last_stats``, and the active run's heartbeat snapshot.  All
+        host-side dicts: a scrape adds ZERO device stats fetches
+        (asserted in tests/test_flightdeck.py)."""
+        from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
+
+        text = metrics_mod.render_exposition(
+            metrics_mod.scheduler_metrics(
+                self.sched,
+                uptime_s=time.time() - self._t0,
+                warmed=self.warmed,
+            )
+        )
+        protocol.send_json(w, {"ok": True, "metrics": text})
 
     def _op_shutdown(self, req, w) -> None:
         protocol.send_json(w, {"ok": True, "stopping": True})

@@ -27,7 +27,11 @@ device engines emit ``compact`` records — per-fetch deltas of the
 stream-compaction dispatch counters with the active ``impl`` — held
 to their fields only at v3 via FIELD_SINCE, so pre-r10 streams stay
 validator-clean; r11: the checker daemon's ``job_*`` + ``serve``
-lifecycle events, required fields gated at v4).  Bench rules: ``bench_schema`` >= 2 requires the
+lifecycle events, required fields gated at v4; r12: ``job_suspend``
+carries ``slice_wall_s`` and ``job_resume`` carries ``restore_s`` —
+the measured context-switch halves — gated at v5).  ``--trace``
+validates an exported Perfetto trace file's event structure instead
+(obs/trace.py).  Bench rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
 ``ckpt_retries``, >= 5 additionally ``compact_impl``.
@@ -212,6 +216,11 @@ def main(argv=None) -> int:
         "--all-bench", action="store_true",
         help="also validate every BENCH_*.json in the repo root",
     )
+    ap.add_argument(
+        "--trace", action="store_true",
+        help="treat the .json files as exported Perfetto traces "
+        "(cli.py trace output) and validate their event structure",
+    )
     args = ap.parse_args(argv)
     files = list(args.files)
     if args.all_bench:
@@ -225,6 +234,10 @@ def main(argv=None) -> int:
     for p in files:
         if p.endswith(".jsonl"):
             errors += validate_stream(p)
+        elif args.trace:
+            from pulsar_tlaplus_tpu.obs.trace import validate_trace
+
+            errors += validate_trace(p)
         else:
             errors += validate_bench_artifact(p)
     for e in errors:

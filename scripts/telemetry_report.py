@@ -57,7 +57,14 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--jobs", action="store_true",
         help="render the per-job lifecycle table of a checker-daemon "
-        "stream (schema v4 job_* events, docs/service.md)",
+        "stream (schema v4 job_* events; v5 adds the per-slice "
+        "suspend/restore overhead columns — docs/service.md)",
+    )
+    ap.add_argument(
+        "--trace", default=None, metavar="OUT.json",
+        help="export the stream(s) as Perfetto-loadable Chrome trace "
+        "JSON instead of tables (obs/trace.py; --compare streams "
+        "render as separate trace processes)",
     )
     args = ap.parse_args(argv)
 
@@ -76,6 +83,17 @@ def main(argv=None) -> int:
             print(f"{p}: no telemetry events", file=sys.stderr)
             return 2
         streams.append((lbl, evs))
+
+    if args.trace:
+        from pulsar_tlaplus_tpu.obs import trace as trace_mod
+
+        tr = trace_mod.write_trace(streams, args.trace)
+        n = sum(1 for e in tr["traceEvents"] if e.get("ph") != "M")
+        print(
+            f"wrote {args.trace}: {n} event(s) — open in "
+            "https://ui.perfetto.dev"
+        )
+        return 0
 
     if args.bench_keys:
         print(json.dumps(report.bench_keys(streams[0][1]), indent=2))

@@ -1,0 +1,320 @@
+---------------------------- MODULE compaction ----------------------------
+(***************************************************************************)
+(* Pulsar topic compaction (thetumbled/pulsar-tlaplus), vendored for this  *)
+(* repo: the spec->kernel compiler, generic interpreter, and the pyeval    *)
+(* oracle (ref/pyeval.py) are all differentially tested against this       *)
+(* module.  A producer appends keyed messages; a two-phase compactor       *)
+(* builds a compacted ledger (phase one scans for the latest position per  *)
+(* key, phase two writes/publishes it); the broker may crash between any   *)
+(* two compactor steps, rolling the compaction horizon back to the last    *)
+(* persisted cursor.  Two known, unfixed Pulsar bugs are expressible as    *)
+(* invariant violations: CompactedLedgerLeak (more than two compacted      *)
+(* ledgers alive) and DuplicateNullKeyMessage (a retained null-key entry   *)
+(* readable both from the compacted ledger and the topic tail).           *)
+(*                                                                         *)
+(* Ground truth at the shipped configuration (compaction.cfg):             *)
+(* 45,198 distinct reachable states, search depth (diameter) 20.           *)
+(***************************************************************************)
+EXTENDS Naturals, Sequences, FiniteSets
+
+CONSTANTS
+    MessageSentLimit,      \* messages the producer may send
+    CompactionTimesLimit,  \* compacted-ledger slots (compaction runs)
+    ModelConsumer,         \* include the consumer role
+    ConsumeTimesLimit,     \* consumer reads to termination
+    KeySpace,              \* message keys (NullKey added below)
+    ValueSpace,            \* message values (NullValue added below)
+    RetainNullKey,         \* compaction keeps null-key messages
+    MaxCrashTimes,         \* bound on broker crashes
+    ModelProducer          \* TRUE: producer acts; FALSE: drawn at Init
+
+ASSUME
+    /\ MessageSentLimit \in Nat
+    /\ CompactionTimesLimit \in Nat
+    /\ ModelConsumer \in BOOLEAN
+    /\ ConsumeTimesLimit \in Nat
+    /\ KeySpace \in SUBSET Nat
+    /\ ValueSpace \in SUBSET Nat
+    /\ RetainNullKey \in BOOLEAN
+    /\ MaxCrashTimes \in Nat
+    /\ ModelProducer \in BOOLEAN
+
+CONSTANTS
+    Nil,
+    Compactor_In_PhaseOne,
+    Compactor_In_PhaseTwoWrite,
+    Compactor_In_PhaseTwoUpdateContext,
+    Compactor_In_PhaseTwoUpdateHorizon,
+    Compactor_In_PhaseTwoPersistCusror,  \* [sic] the reference's spelling
+    Compactor_In_PhaseTwoDeleteLedger
+
+NullKey == 0
+NullValue == 0
+KeySet == KeySpace \cup {NullKey}
+ValueSet == ValueSpace \cup {NullValue}
+
+CompactorStates == {
+    Compactor_In_PhaseOne,
+    Compactor_In_PhaseTwoWrite,
+    Compactor_In_PhaseTwoUpdateContext,
+    Compactor_In_PhaseTwoUpdateHorizon,
+    Compactor_In_PhaseTwoPersistCusror,
+    Compactor_In_PhaseTwoDeleteLedger
+}
+
+VARIABLES
+    messages,              \* sequence of [id, key, value] records
+    compactedLedgers,      \* [1..CompactionTimesLimit -> Nil | seq of records]
+    cursor,                \* Nil | [compactionHorizon, compactedTopicContext]
+    compactorState,        \* one of CompactorStates
+    phaseOneResult,        \* Nil | [readPosition, latestForKey]
+    compactionHorizon,     \* messages 1..horizon are served compacted
+    compactedTopicContext, \* id of the ledger serving the compacted view
+    crashTimes,            \* broker crashes so far
+    consumeTimes           \* consumer reads so far
+
+vars == <<messages, compactedLedgers, cursor, compactorState, phaseOneResult,
+          compactionHorizon, compactedTopicContext, crashTimes, consumeTimes>>
+
+MessageSpace == [id: 1..MessageSentLimit, key: KeySet, value: ValueSet]
+
+Max(S) == CHOOSE x \in S : \A y \in S : y <= x
+
+(* The producer appends the next message (id = its position). *)
+Producer ==
+    /\ Len(messages) < MessageSentLimit
+    /\ \E key \in KeySet :
+        \E value \in ValueSet :
+            messages' = Append(messages,
+                [id |-> Len(messages) + 1, key |-> key, value |-> value])
+    /\ UNCHANGED <<compactedLedgers, cursor, compactorState, phaseOneResult,
+                   compactionHorizon, compactedTopicContext, crashTimes,
+                   consumeTimes>>
+
+(* Phase one: scan the whole topic, recording the read position and the
+   latest position of every non-null key. *)
+CompactorPhaseOne ==
+    /\ compactorState = Compactor_In_PhaseOne
+    /\ phaseOneResult = Nil
+    /\ Len(messages) > 0
+    /\ LET n == Len(messages)
+           keys == {messages[i].key : i \in 1..n} \ {NullKey}
+       IN phaseOneResult' = [
+              readPosition |-> n,
+              latestForKey |-> [k \in keys |->
+                  Max({i \in 1..n : messages[i].key = k})]]
+    /\ compactorState' = Compactor_In_PhaseTwoWrite
+    /\ UNCHANGED <<messages, compactedLedgers, cursor, compactionHorizon,
+                   compactedTopicContext, crashTimes, consumeTimes>>
+
+(* The id of the newest live compacted ledger; 0 when none exists. *)
+MaxCompactedLedgerId ==
+    IF \A i \in 1..CompactionTimesLimit : compactedLedgers[i] = Nil
+    THEN 0
+    ELSE CHOOSE i \in 1..CompactionTimesLimit :
+            /\ compactedLedgers[i] # Nil
+            /\ \A j \in 1..CompactionTimesLimit :
+                   j > i => compactedLedgers[j] = Nil
+
+(* The compacted view of the scanned prefix: null-key messages survive
+   iff RetainNullKey; keyed messages survive only at their key's latest
+   scanned position.  (Message ids equal their positions, by Init and
+   Producer.) *)
+CompactedMessages ==
+    LET rp == phaseOneResult.readPosition
+        lm == phaseOneResult.latestForKey
+    IN SelectSeq(messages,
+           LAMBDA m :
+               /\ m.id <= rp
+               /\ IF m.key = NullKey
+                  THEN RetainNullKey
+                  ELSE m.id = lm[m.key])
+
+(* Phase two, step 1: write the compacted ledger into the next slot. *)
+CompactorPhaseTwoWrite ==
+    /\ phaseOneResult # Nil
+    /\ compactorState = Compactor_In_PhaseTwoWrite
+    /\ LET newLedgerId == MaxCompactedLedgerId + 1
+       IN /\ newLedgerId >= 1
+          /\ newLedgerId <= CompactionTimesLimit
+          /\ compactedLedgers' =
+                 [compactedLedgers EXCEPT ![newLedgerId] = CompactedMessages]
+    /\ compactorState' = Compactor_In_PhaseTwoUpdateContext
+    /\ UNCHANGED <<messages, cursor, phaseOneResult, compactionHorizon,
+                   compactedTopicContext, crashTimes, consumeTimes>>
+
+(* Phase two, step 2: point the topic context at the new ledger. *)
+CompactorPhaseTwoUpdateContext ==
+    /\ compactorState = Compactor_In_PhaseTwoUpdateContext
+    /\ compactedTopicContext' = MaxCompactedLedgerId
+    /\ compactorState' = Compactor_In_PhaseTwoUpdateHorizon
+    /\ UNCHANGED <<messages, compactedLedgers, cursor, phaseOneResult,
+                   compactionHorizon, crashTimes, consumeTimes>>
+
+(* Phase two, step 3: advance the compaction horizon to the scan edge. *)
+CompactorPhaseTwoUpdateHorizon ==
+    /\ compactorState = Compactor_In_PhaseTwoUpdateHorizon
+    /\ compactionHorizon' = phaseOneResult.readPosition
+    /\ compactorState' = Compactor_In_PhaseTwoPersistCusror
+    /\ UNCHANGED <<messages, compactedLedgers, cursor, phaseOneResult,
+                   compactedTopicContext, crashTimes, consumeTimes>>
+
+(* Phase two, step 4: persist horizon + context durably in the cursor. *)
+CompactorPhaseTwoPersistCusror ==
+    /\ compactorState = Compactor_In_PhaseTwoPersistCusror
+    /\ cursor' = [compactionHorizon |-> compactionHorizon,
+                  compactedTopicContext |-> compactedTopicContext]
+    /\ compactorState' = Compactor_In_PhaseTwoDeleteLedger
+    /\ UNCHANGED <<messages, compactedLedgers, phaseOneResult,
+                   compactionHorizon, compactedTopicContext, crashTimes,
+                   consumeTimes>>
+
+(* Phase two, step 5: delete the superseded ledger (the one before the
+   newest), then return to phase one. *)
+CompactorPhaseTwoDeleteLedger ==
+    /\ compactorState = Compactor_In_PhaseTwoDeleteLedger
+    /\ LET maxLedgerId == MaxCompactedLedgerId
+           oldLedgerId == IF maxLedgerId = 1 THEN Nil ELSE maxLedgerId - 1
+       IN compactedLedgers' =
+              IF /\ oldLedgerId # Nil
+                 /\ compactedLedgers[oldLedgerId] # Nil
+              THEN [compactedLedgers EXCEPT ![oldLedgerId] = Nil]
+              ELSE compactedLedgers
+    /\ compactorState' = Compactor_In_PhaseOne
+    /\ phaseOneResult' = Nil
+    /\ UNCHANGED <<messages, cursor, compactionHorizon,
+                   compactedTopicContext, crashTimes, consumeTimes>>
+
+(* A broker crash aborts any in-flight compaction and rolls the served
+   horizon/context back to the last persisted cursor. *)
+BrokerCrash ==
+    /\ crashTimes < MaxCrashTimes
+    /\ crashTimes' = crashTimes + 1
+    /\ compactorState' = Compactor_In_PhaseOne
+    /\ phaseOneResult' = Nil
+    /\ IF cursor = Nil
+       THEN /\ compactionHorizon' = 0
+            /\ compactedTopicContext' = 0
+       ELSE /\ compactionHorizon' = cursor.compactionHorizon
+            /\ compactedTopicContext' = cursor.compactedTopicContext
+    /\ UNCHANGED <<messages, compactedLedgers, cursor, consumeTimes>>
+
+(* The consumer is modeled as a read-only observer (a stutter step). *)
+Consumer ==
+    UNCHANGED vars
+
+(* Init: either an empty topic the producer fills (ModelProducer), or a
+   draw over every id-consistent full-length message sequence. *)
+Init ==
+    /\ \/ /\ ModelProducer
+          /\ messages = <<>>
+       \/ /\ ~ModelProducer
+          /\ messages \in {ms \in [1..MessageSentLimit -> MessageSpace] :
+                               \A i \in 1..MessageSentLimit : ms[i].id = i}
+    /\ compactedLedgers = [i \in 1..CompactionTimesLimit |-> Nil]
+    /\ cursor = Nil
+    /\ compactorState = Compactor_In_PhaseOne
+    /\ phaseOneResult = Nil
+    /\ compactionHorizon = 0
+    /\ compactedTopicContext = 0
+    /\ crashTimes = 0
+    /\ consumeTimes = 0
+
+(* The run is complete: every message sent, every compaction slot used,
+   the compactor parked before its (impossible) next write, and — when
+   modeled — the consumer done. *)
+TerminationCondition ==
+    /\ Len(messages) = MessageSentLimit
+    /\ compactorState = Compactor_In_PhaseTwoWrite
+    /\ MaxCompactedLedgerId = CompactionTimesLimit
+    /\ ModelConsumer => consumeTimes = ConsumeTimesLimit
+
+(* Self-loop at complete states so TLC reports no deadlock. *)
+Terminating ==
+    /\ TerminationCondition
+    /\ UNCHANGED vars
+
+Next ==
+    \/ /\ ModelProducer
+       /\ Producer
+    \/ CompactorPhaseOne
+    \/ CompactorPhaseTwoWrite
+    \/ CompactorPhaseTwoUpdateContext
+    \/ CompactorPhaseTwoUpdateHorizon
+    \/ CompactorPhaseTwoPersistCusror
+    \/ CompactorPhaseTwoDeleteLedger
+    \/ BrokerCrash
+    \/ /\ ModelConsumer
+       /\ Consumer
+    \/ Terminating
+
+Spec == Init /\ [][Next]_vars
+
+----------------------------------------------------------------------------
+(* Invariants *)
+
+MessageOK(m) ==
+    /\ m.id \in 1..MessageSentLimit
+    /\ m.key \in KeySet
+    /\ m.value \in ValueSet
+
+TypeSafe ==
+    /\ \A i \in 1..Len(messages) : MessageOK(messages[i])
+    /\ \A l \in 1..CompactionTimesLimit :
+        \/ compactedLedgers[l] = Nil
+        \/ \A i \in 1..Len(compactedLedgers[l]) :
+               MessageOK(compactedLedgers[l][i])
+    /\ \/ phaseOneResult = Nil
+       \/ /\ phaseOneResult.readPosition \in 1..Len(messages)
+          /\ \A k \in DOMAIN phaseOneResult.latestForKey :
+                 phaseOneResult.latestForKey[k] \in 1..Len(messages)
+    /\ compactorState \in CompactorStates
+    /\ compactionHorizon \in 0..MessageSentLimit
+    /\ compactedTopicContext \in 0..CompactionTimesLimit
+    /\ crashTimes \in 0..MaxCrashTimes
+    /\ \/ cursor = Nil
+       \/ /\ cursor.compactionHorizon \in 1..MessageSentLimit
+          /\ cursor.compactedTopicContext \in 1..CompactionTimesLimit
+
+(* Pulsar bug #1: crashes between DeleteLedger steps leak ledgers — more
+   than two may be alive at once. *)
+CompactedLedgerLeak ==
+    Cardinality({l \in 1..CompactionTimesLimit :
+                     compactedLedgers[l] # Nil}) <= 2
+
+(* Every message below the horizon is represented in the serving ledger
+   by an entry for its key at least as new as itself. *)
+CompactionHorizonCorrectness ==
+    LET ledger == compactedLedgers[compactedTopicContext]
+    IN \/ compactionHorizon = 0
+       \/ \A i \in 1..compactionHorizon :
+              LET m == messages[i]
+              IN IF m.key = NullKey /\ ~RetainNullKey
+                 THEN TRUE
+                 ELSE \E j \in 1..Len(ledger) :
+                          /\ ledger[j].key = m.key
+                          /\ ledger[j].id >= m.id
+
+(* Pulsar bug #2: a retained null-key message can be served twice — once
+   from the compacted ledger and once from the topic tail above the
+   horizon. *)
+DuplicateNullKeyMessage ==
+    \/ ~RetainNullKey
+    \/ compactedTopicContext = 0
+    \/ LET ledger == compactedLedgers[compactedTopicContext]
+       IN \/ ledger = Nil
+          \/ \A i \in 1..Len(ledger) :
+                 ledger[i].key = NullKey =>
+                     \A j \in (compactionHorizon + 1)..Len(messages) :
+                         messages[j] # ledger[i]
+
+----------------------------------------------------------------------------
+(* Temporal properties *)
+
+Termination ==
+    <>(/\ Len(messages) = MessageSentLimit
+       /\ compactorState = Compactor_In_PhaseTwoWrite
+       /\ MaxCompactedLedgerId = CompactionTimesLimit
+       /\ ModelConsumer => consumeTimes = ConsumeTimesLimit)
+
+============================================================================

@@ -6,7 +6,13 @@ import random
 import jax
 import pytest
 
+from pulsar_tlaplus_tpu.frontend.loader import reference_spec_path
 from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+# The reference compaction module: the vendored specs/compaction.tla
+# wins; /root/reference/ (the original retrieval mount) is the fallback
+# on hosts that still carry it.
+REFERENCE_TLA = reference_spec_path("compaction")
 
 # Both sharded engines build on jax.shard_map (added after jax 0.4.37,
 # the container's version).  Known-environment failures are noise, not

@@ -17,7 +17,7 @@ from pulsar_tlaplus_tpu.frontend.parser import parse_file
 from pulsar_tlaplus_tpu.ref import pyeval as pe
 from tests.helpers import needs_shard_map, SMALL_CONFIGS
 
-REFERENCE_TLA = "/root/reference/compaction.tla"
+from tests.helpers import REFERENCE_TLA  # specs/ first, /root/reference fallback
 
 
 @pytest.fixture(scope="module")

@@ -14,7 +14,7 @@ from pulsar_tlaplus_tpu.frontend.loader import (
 from pulsar_tlaplus_tpu.frontend.parser import parse_file, parse_module
 from pulsar_tlaplus_tpu.ref import pyeval as pe
 
-REFERENCE_TLA = "/root/reference/compaction.tla"
+from tests.helpers import REFERENCE_TLA  # specs/ first, /root/reference fallback
 
 
 @pytest.fixture(scope="module")

@@ -15,7 +15,7 @@ from pulsar_tlaplus_tpu.frontend.parser import parse_file
 from pulsar_tlaplus_tpu.ref import pyeval
 from tests.helpers import SMALL_CONFIGS
 
-REFERENCE_TLA = "/root/reference/compaction.tla"
+from tests.helpers import REFERENCE_TLA  # specs/ first, /root/reference fallback
 
 # compaction_times_limit=3 makes CompactedLedgerLeak violable (needs three
 # live ledger slots; same config as test_frontend's bug repro).

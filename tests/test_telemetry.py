@@ -93,7 +93,8 @@ def test_zero_sync_counters_ride_the_stats_fetch(std_run):
     telemetry-specific fetches (one flush record per stats fetch at
     most)."""
     _stream, _frame, ck, r, events = std_run
-    assert FPM_N == 5
+    # r12: valid_lanes split into hi/lo uint32 words (int32-wrap fix)
+    assert FPM_N == 6
     stats = [e for e in events if e["event"] == "result"][-1]["stats"]
     flushes = [e for e in events if e["event"] == "flush"]
     assert stats["fpset_flushes"] == sum(e["flushes"] for e in flushes)
