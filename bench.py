@@ -11,7 +11,7 @@ Engine: the device-resident checker (engine/device_bfs.py) — everything
 (visited set, frontier, trace log) stays in HBM; the host fetches one
 small stats vector per group of sub-batches.  This matters because the
 TPU sits behind a tunnel with ~130 ms host<->device round-trip latency
-and ~20 MB/s transfer bandwidth (measured; scripts/profile_expand2.py),
+and ~20 MB/s transfer bandwidth (measured; scripts/profile.py expand),
 which is what throttled the round-1 engine to 22k states/s.
 
 Baselines (BASELINE.md; the image has no JVM, so 8-worker CPU TLC — the
@@ -79,7 +79,7 @@ def scaled_config():
 
 
 # The checker tier the bench runs at — exported so probes/profilers
-# (scripts/probe_aot.py --big, scripts/profile_stages5.py) populate the
+# (scripts/probe_aot.py --big, scripts/profile.py stages --run) populate the
 # AOT executable cache with EXACTLY the programs the bench loads (the
 # tier shapes the lowered HLO and thus the cache key).
 BENCH_CHECKER_KW = dict(
@@ -297,6 +297,17 @@ def parse_args(argv=None):
         "differential timing)",
     )
     ap.add_argument(
+        "--fuse", choices=["level", "stage"], default="level",
+        help="dispatch fusion: level (one fused megakernel dispatch "
+        "per BFS level, ramp levels batched — default) or stage (the "
+        "r10 per-stage dispatch chain, kept for differential timing)",
+    )
+    ap.add_argument(
+        "--fuse-group", dest="fuse_group", type=int, default=None,
+        help="with --fuse level: max ramp levels batched per dispatch "
+        "(default auto, up to 8; 1 disables batching)",
+    )
+    ap.add_argument(
         "--checkpoint", default=None,
         help="write level-boundary checkpoint frames to this .npz "
         "(survivable bench runs: SIGTERM/SIGINT exit resumably, HBM "
@@ -418,6 +429,8 @@ def main(argv=None):
         metrics_path=metrics_path,
         visited_impl=args.visited,
         compact_impl=args.compact,
+        fuse=args.fuse,
+        fuse_group=args.fuse_group,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         telemetry=args.telemetry,
@@ -567,8 +580,11 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # frame writer's transient-failure retry breadcrumb);
                 # schema 5 (r10) adds compact_impl and sources the
                 # telemetry-derived keys from the stream itself
-                # — validated by scripts/check_telemetry_schema.py
-                "bench_schema": 5,
+                # — validated by scripts/check_telemetry_schema.py;
+                # schema 6 (r13) adds the level-fusion mode + the
+                # run's dispatch economy (dispatches_per_level,
+                # stage_fused_n, fuse_levels)
+                "bench_schema": 6,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2
@@ -630,6 +646,16 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # stream-compaction impl on the append hot path (r10:
                 # logshift default; sort kept for differential timing)
                 "compact_impl": args.compact,
+                # level fusion (r13): the megakernel's dispatch
+                # economy — total dispatches per BFS level, fused
+                # dispatches, and levels the ramp batched.  ck.fuse,
+                # not args.fuse: the engine silently falls back to the
+                # stage chain under --visited sort, and the artifact
+                # must report the mode that actually ran
+                "fuse": ck.fuse,
+                "dispatches_per_level": stat("dispatches_per_level"),
+                "stage_fused_n": stat("stage_fused_n"),
+                "fuse_levels": stat("fuse_levels"),
                 # per-stage dispatch counts straight from the stream
                 # (the telemetry_report --bench-keys layer; None when
                 # --no-telemetry)
@@ -649,13 +675,14 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "fpset_duplicate_ratio": stat("fpset_duplicate_ratio"),
                 "fpset_max_probe_rounds": stat("fpset_max_probe_rounds"),
                 "engine": (
-                    "device_bfs r6 (fpset HBM hash-table visited set — "
-                    "no visited-width flush sort; frontier-window row "
+                    "device_bfs r13 (fused level megakernel — one "
+                    "dispatch per BFS level, ramp batching; fpset HBM "
+                    "hash-table visited set, frontier-window row "
                     "store, flush_factor=3, AOT executable cache, "
                     "64-bit fingerprints)"
-                    if args.visited == "fpset"
-                    else "device_bfs r5-compat (--visited sort: legacy "
-                    "sort-merge flush)"
+                    if args.visited == "fpset" and args.fuse == "level"
+                    else "device_bfs r10-compat (--fuse stage / "
+                    "--visited sort: per-stage dispatch chain)"
                 ),
             }
         )

@@ -158,6 +158,8 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         metrics_path=args.metrics,
         visited_impl=args.visited,
         compact_impl=args.compact,
+        fuse=args.fuse,
+        fuse_group=args.fuse_group,
         telemetry=args.telemetry,
         heartbeat_s=args.progress,
         xprof_dir=args.xprof,
@@ -473,6 +475,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             metrics_path=args.metrics,
             visited_impl=args.visited,
             compact_impl=args.compact,
+            fuse=args.fuse,
+            fuse_group=args.fuse_group,
             checkpoint_path=args.checkpoint,
             telemetry=args.telemetry,
             heartbeat_s=args.progress,
@@ -1135,6 +1139,26 @@ def main(argv=None):
         "append/sweep hot paths: 'logshift' (sort-free prefix-sum + "
         "doubling shifts, default) or 'sort' (the legacy chunked "
         "single-key sorts, kept for differential timing)",
+    )
+    pc.add_argument(
+        "-fuse",
+        choices=["level", "stage"],
+        default="level",
+        help="device-engine dispatch fusion: 'level' (default — one "
+        "fused megakernel dispatch per BFS level, with shallow ramp "
+        "levels batched several-per-dispatch) or 'stage' (the legacy "
+        "per-stage dispatch chain, kept for bit-for-bit differential "
+        "timing, mirroring -visited sort / -compact sort)",
+    )
+    pc.add_argument(
+        "-fuse-group",
+        dest="fuse_group",
+        type=int,
+        default=None,
+        metavar="G",
+        help="with -fuse level: max ramp levels batched into one "
+        "dispatch (default: auto from the frontier size, up to 8; "
+        "1 disables ramp batching)",
     )
     pc.add_argument(
         "-sweep-group",

@@ -1,7 +1,7 @@
 """Fully device-resident BFS checker — the round-3 throughput engine.
 
 Motivation (all numbers measured on the v5e chip behind the axon tunnel,
-``scripts/profile_expand2.py`` / ``scripts/profile_lsm.py``):
+``scripts/profile.py expand --mode chained`` / ``lsm``):
 
 - one host<->device sync costs ~130 ms round-trip and bulk transfers run
   at ~17-30 MB/s, so ANY per-chunk host involvement dominates wall time;
@@ -43,6 +43,27 @@ Counterexample traces: the per-state ``(parent gid, action lane)`` log
 is appended by the same scatter as the rows; a trace is reconstructed by
 walking the parent chain on device (one fetch) and replaying lanes
 through the model on the host (SURVEY.md §2.2-E7).
+
+Round-13 fusion (``fuse="level"``, the default): the per-level stage
+chain (expand -> fpset lookup_or_insert -> stream compact -> append,
+each its own jitted dispatch since round 10) collapses into ONE
+megakernel dispatch per level — ``_fused_jit`` chains the identical
+traced sub-functions (``ops.fpset.flush_acc``, ``ops.compact.
+compact_rows``, the expand/append bodies below) with every buffer
+donated end-to-end, and a ``lax.while_loop`` walks flush groups AND
+level boundaries inside the dispatch.  Small consecutive levels (the
+dispatch-bound ramp: frontiers at or below one expand window) batch up
+to ``fuse_group`` levels per dispatch, with early exit on frontier
+growth past the window, violation/deadlock, or capacity; the kernel
+returns per-level sizes so host-side level accounting, telemetry
+``level`` records, checkpoint frames, and ``PTT_FAULT`` level/flush
+sites replay exactly.  Steady-state levels therefore cost 1 dispatch +
+1 stats fetch (the kernel returns the stats vector — no separate stats
+dispatch), and the whole ramp costs 1.  ``fuse="stage"`` keeps the
+round-10 chain verbatim for bit-for-bit differential timing (mirroring
+``-visited sort`` / ``-compact sort``); discovery order is identical
+state-for-state either way (same flush partition, same lane ids, same
+min-lane-wins dedup).
 """
 
 from __future__ import annotations
@@ -119,6 +140,8 @@ class DeviceChecker:
         row_cap_states: Optional[int] = None,
         visited_impl: str = "fpset",
         compact_impl: str = "logshift",
+        fuse: str = "level",
+        fuse_group: Optional[int] = None,
         fpset_dense_rounds: Optional[int] = None,
         fpset_stages=None,
         checkpoint_path: Optional[str] = None,
@@ -215,6 +238,26 @@ class DeviceChecker:
         # the round-6 -visited sort pattern).  The fpset's staged
         # pending-compaction uses the same impl inside the flush.
         self.compact_impl = compact_ops.validate_impl(compact_impl)
+        # Level fusion (round 13 tentpole): "level" (default) runs each
+        # BFS level as ONE fused megakernel dispatch (ramp levels batch
+        # several levels per dispatch — see the module docstring);
+        # "stage" keeps the round-10 per-stage dispatch chain for
+        # bit-for-bit differential timing.  The fused kernel chains the
+        # fpset probe, so the legacy sort-merge visited set always runs
+        # the stage chain (the r6 differential path stays exact).
+        if fuse not in ("level", "stage"):
+            raise ValueError(f"fuse must be level|stage: {fuse}")
+        if visited_impl == "sort":
+            fuse = "stage"
+        self.fuse = fuse
+        # ramp batch depth: max levels one fused dispatch may close
+        # (static — it shapes the kernel's per-level size vector).  The
+        # cost model batches only while the frontier fits one expand
+        # window (auto, the r10 --sweep-group pattern); an explicit
+        # fuse_group caps or disables (1) the batching.
+        if fuse_group is not None and fuse_group < 1:
+            raise ValueError(f"fuse_group must be >= 1: {fuse_group}")
+        self.RMAX = min(fuse_group or 8, 64)
         # fpset probe schedule: ctor params > PTT_FPSET_SCHEDULE env >
         # ops/fpset.py defaults (the real-chip tuning pass sweeps these
         # against the fpset_max_probe_rounds telemetry signal)
@@ -390,6 +433,16 @@ class DeviceChecker:
 
             print(f"  {msg}", file=sys.stderr, flush=True)
 
+    def _dispatch_total(self) -> int:
+        """Sum of every ``stage_<name>_n`` dispatch counter — one
+        definition for the run-start baseline AND the result's
+        ``dispatches_per_level`` numerator."""
+        return sum(
+            int(v)
+            for k, v in self.last_stats.items()
+            if k.startswith("stage_") and k.endswith("_n")
+        )
+
     def _stage_mark(self, name: str, out):
         """Per-stage accounting.  Dispatch counts (``stage_<name>_n``)
         are free host-side counters and always ride.  Under
@@ -426,7 +479,7 @@ class DeviceChecker:
         (minor dim padded toward 128), and ops like gather/DUS can
         force a full T(8,128) relayout copy of the whole store — 6.4x
         memory, an instant OOM at bench sizes (measured,
-        scripts/profile_lsm.py).  Flat u32 vectors have no pad; kernels
+        scripts/profile.py lsm).  Flat u32 vectors have no pad; kernels
         reshape small windows internally."""
         key = ("slice", self.LCAP)
         if key in self._jits:
@@ -440,24 +493,22 @@ class DeviceChecker:
         self._jits[key] = fn
         return fn
 
-    def _expand_jit(self):
-        """(ak cols, arows[W, ACAP] (word-major SoA), flat window[G*W],
-        f_off, n_live, dead_gid, gid_base, acc_off) -> (ak', arows',
-        dead_gid').
-
-        Expands one G-state window into ``NCs`` candidate lanes and
-        appends their key columns + packed rows into the accumulator at
-        ``acc_off``.  ``f_off`` is the window's first row index within
-        the current level (for liveness masking and deadlock gids);
-        capacity-independent apart from the fixed ACAP."""
-        key = ("expand",)
-        if key in self._jits:
-            return self._jits[key]
+    def _expand_body(
+        self, ak, arows, window, f_off, n_live, dead_gid, gid_base,
+        acc_off,
+    ):
+        """Traced expand sub-function (shared by ``_expand_jit`` and
+        the fused level megakernel): expand one G-state window into
+        ``NCs`` candidate lanes and append their key columns + packed
+        rows into the accumulator at ``acc_off``.  ``f_off`` is the
+        window's first row index within the current level (for
+        liveness masking and deadlock gids).  Returns
+        ``(ak', arows', dead_gid')``."""
         m, layout = self.model, self.layout
         Fi, A, W, G = self.Fi, self.A, self.W, self.G
         keyspec = self.keys
 
-        def chunk(window, f_off, n_live, i):
+        def chunk(i):
             rows = lax.dynamic_slice(
                 window, (i * Fi * W,), (Fi * W,)
             ).reshape(Fi, W)
@@ -480,31 +531,43 @@ class DeviceChecker:
                 didx = BIG
             return kcols, packedf, didx
 
+        def body(dead, i):
+            kcols, p, didx = chunk(i)
+            dead = jnp.minimum(
+                dead, jnp.where(didx < BIG, gid_base + didx, BIG)
+            )
+            return dead, (kcols, p)
+
+        dead, (kcols, packed) = lax.scan(
+            body, dead_gid, jnp.arange(G // Fi, dtype=jnp.int32)
+        )
+        nc = G * A
+        ak = tuple(
+            lax.dynamic_update_slice(akc, kc.reshape(nc), (acc_off,))
+            for akc, kc in zip(ak, kcols)
+        )
+        arows = lax.dynamic_update_slice(
+            arows, packed.reshape(nc, W).T, (0, acc_off)
+        )
+        return ak, arows, dead
+
+    def _expand_jit(self):
+        """(ak cols, arows[W, ACAP] (word-major SoA), flat window[G*W],
+        f_off, n_live, dead_gid, gid_base, acc_off) -> (ak', arows',
+        dead_gid') — the stage-chain dispatch over ``_expand_body``;
+        capacity-independent apart from the fixed ACAP."""
+        key = ("expand",)
+        if key in self._jits:
+            return self._jits[key]
+
         def step(*args):
             ak = args[: self.K]
             arows, window, f_off, n_live, dead_gid, gid_base, acc_off = args[
                 self.K:
             ]
-
-            def body(dead, i):
-                kcols, p, didx = chunk(window, f_off, n_live, i)
-                dead = jnp.minimum(
-                    dead, jnp.where(didx < BIG, gid_base + didx, BIG)
-                )
-                return dead, (kcols, p)
-
-            dead, (kcols, packed) = lax.scan(
-                body, dead_gid, jnp.arange(G // Fi, dtype=jnp.int32)
-            )
-            nc = G * A
-            ak = tuple(
-                lax.dynamic_update_slice(
-                    akc, kc.reshape(nc), (acc_off,)
-                )
-                for akc, kc in zip(ak, kcols)
-            )
-            arows = lax.dynamic_update_slice(
-                arows, packed.reshape(nc, W).T, (0, acc_off)
+            ak, arows, dead = self._expand_body(
+                ak, arows, window, f_off, n_live, dead_gid, gid_base,
+                acc_off,
             )
             return (*ak, arows, dead)
 
@@ -594,7 +657,7 @@ class DeviceChecker:
             # the per-slot flag vector — the append then compacts rows
             # with a value-carrying sort instead of a gather (gathers
             # are latency-bound per element on TPU: an appended flush
-            # measured 10.9 s/8.9M lanes before this, profile_stages)
+            # measured 10.9 s/8.9M lanes before this, scripts/profile.py stages)
             _, flag_sorted = lax.sort(
                 (sp, new_flag.astype(jnp.uint32)), num_keys=1,
                 is_stable=False,
@@ -630,28 +693,20 @@ class DeviceChecker:
         )
         if key in self._jits:
             return self._jits[key]
-        ACAP, K = self.ACAP, self.K
+        K = self.K
 
         def step(*args):
             tc = args[:K]
             ak = args[K: 2 * K]
             n_acc, fpm = args[2 * K], args[2 * K + 1]
-            lanei = jnp.arange(ACAP, dtype=jnp.int32)
-            amask = lanei < n_acc  # stale tail from a previous fill
-            valid = amask & ~fpset.all_sentinel(ak)
-            is_new, tc2, n_failed, rounds = fpset.lookup_or_insert(
-                tc, ak, valid,
+            # the flush body lives in ops/fpset.py since r13 so the
+            # fused level megakernel chains the IDENTICAL trace
+            tc2, n_new, flag, fpm = fpset.flush_acc(
+                tc, ak, n_acc, fpm,
                 dense_rounds=self.fps_dense, stages=self.fps_stages,
                 compact_impl=self.compact_impl,
             )
-            n_new = jnp.sum(is_new.astype(jnp.int32))
-            # hi/lo carry arithmetic for the valid-lane words lives in
-            # the shared helper (r12 int32-wrap fix)
-            fpm = fpset.fpm_update(
-                fpm, rounds, n_failed,
-                jnp.sum(valid.astype(jnp.int32)),
-            )
-            return (*tc2, n_new, is_new.astype(jnp.uint32), fpm)
+            return (*tc2, n_new, flag, fpm)
 
         fn = ajit(step, donate_argnums=tuple(range(self.K)))
         self._jits[key] = fn
@@ -693,7 +748,7 @@ class DeviceChecker:
 
         Gathers are latency-bound per element on TPU (~17-50 ns — a
         gather-based append measured 10.9 s per 8.9M lanes,
-        profile_stages.py), so compaction is dense passes: log-shift
+        scripts/profile.py stages), so compaction is dense passes: log-shift
         by default (``ops/compact.py``: exclusive prefix sum + log2(A)
         masked doubling shifts, contiguous copies only), the round-4
         chunked single-key sorts behind ``compact_impl="sort"`` for
@@ -706,16 +761,12 @@ class DeviceChecker:
         key = ("compact", self.compact_impl)
         if key in self._jits:
             return self._jits[key]
-        W = self.W
         impl = self.compact_impl
 
         def step(arows, flag_acc):
-            drop = flag_acc ^ jnp.uint32(1)
-            cols = tuple(arows[j] for j in range(W))
-            ccols, idx = compact_ops.compact_by_flag(
-                drop, cols, impl=impl
-            )
-            return jnp.stack(ccols), idx
+            # the row-matrix compaction body lives in ops/compact.py
+            # since r13 (shared with the fused level megakernel)
+            return compact_ops.compact_rows(arows, flag_acc, impl=impl)
 
         fn = ajit(step, donate_argnums=(0,))
         self._jits[key] = fn
@@ -748,97 +799,299 @@ class DeviceChecker:
         key = ("append", self.LCAP, self.PCAP)
         if key in self._jits:
             return self._jits[key]
+
+        def step(rows_store, parent_log, lane_log, crows, idx,
+                 n_new, n_visited, viol, acc_base, is_init, row_base,
+                 rows_ok):
+            return self._append_body(
+                rows_store, parent_log, lane_log, crows, idx, n_new,
+                n_visited, viol, acc_base, is_init, row_base, rows_ok,
+            )
+
+        fn = ajit(step, donate_argnums=(0, 1, 2))
+        self._jits[key] = fn
+        return fn
+
+    def _append_body(self, rows_store, parent_log, lane_log, crows,
+                     idx, n_new, n_visited, viol, acc_base, is_init,
+                     row_base, rows_ok):
+        """Traced append sub-function (shared by ``_append_jit`` and
+        the fused level megakernel) — see :meth:`_append_jit` for the
+        full contract."""
         A, W, ACAP = self.A, self.W, self.ACAP
         SL, C = self.SLc, self.C
         LCAP = self.LCAP
         layout = self.layout
         inv_fns = [self.model.invariants[n] for n in self.invariant_names]
         n_inv = len(self.invariant_names)
-
-        def step(rows_store, parent_log, lane_log, crows, idx,
-                 n_new, n_visited, viol, acc_base, is_init, row_base,
-                 rows_ok):
-            ccols = tuple(crows[j] for j in range(W))
-            lanei = jnp.arange(ACAP, dtype=jnp.int32)
-            live = lanei < n_new
-            par = jnp.where(
-                is_init, -1 - (acc_base + idx), acc_base + idx // A
-            )
-            lane = jnp.where(is_init, 0, idx % A)
-            par = jnp.where(live, par, 0)
-            lane = jnp.where(live, lane, 0)
-            # pad so the chunks can never clamp mid-window
-            pad = C * SL - ACAP
-            ecols = (
-                tuple(
-                    jnp.concatenate(
-                        [c, jnp.zeros((pad,), jnp.uint32)]
-                    )
-                    for c in ccols
+        ccols = tuple(crows[j] for j in range(W))
+        lanei = jnp.arange(ACAP, dtype=jnp.int32)
+        live = lanei < n_new
+        par = jnp.where(
+            is_init, -1 - (acc_base + idx), acc_base + idx // A
+        )
+        lane = jnp.where(is_init, 0, idx % A)
+        par = jnp.where(live, par, 0)
+        lane = jnp.where(live, lane, 0)
+        # pad so the chunks can never clamp mid-window
+        pad = C * SL - ACAP
+        ecols = (
+            tuple(
+                jnp.concatenate(
+                    [c, jnp.zeros((pad,), jnp.uint32)]
                 )
-                if pad
-                else ccols
+                for c in ccols
             )
-            woff = jnp.where(
-                rows_ok, n_visited - row_base, jnp.int32(LCAP - C * SL)
-            )
+            if pad
+            else ccols
+        )
+        woff = jnp.where(
+            rows_ok, n_visited - row_base, jnp.int32(LCAP - C * SL)
+        )
 
-            # the SL-chunked loop does BOTH invariant evaluation and
-            # the row-store append: each chunk interleaves its [SL, W]
-            # rows (needed for the unpack anyway) and lands them with a
-            # blind DUS at [woff + off, ...).  Writing the store
-            # chunk-wise keeps every intermediate SL-sized — a
-            # monolithic [ACAP, W] stack takes the 128-padded T(8,128)
-            # tiled layout on TPU (6.4x memory = 9.1 GB at the ff=2
-            # bench tier; it OOMed the XLA memory planner).  The run
-            # loop guarantees ``woff + APAD <= LCAP`` before
-            # dispatching, so no DUS can clamp.
-            def chunk(c, carry):
-                viol, store = carry
-                off = c * SL
-                rows = jnp.stack(
-                    [
-                        lax.dynamic_slice(col, (off,), (SL,))
-                        for col in ecols
-                    ],
-                    axis=1,
+        # the SL-chunked loop does BOTH invariant evaluation and
+        # the row-store append: each chunk interleaves its [SL, W]
+        # rows (needed for the unpack anyway) and lands them with a
+        # blind DUS at [woff + off, ...).  Writing the store
+        # chunk-wise keeps every intermediate SL-sized — a
+        # monolithic [ACAP, W] stack takes the 128-padded T(8,128)
+        # tiled layout on TPU (6.4x memory = 9.1 GB at the ff=2
+        # bench tier; it OOMed the XLA memory planner).  The run
+        # loop guarantees ``woff + APAD <= LCAP`` before
+        # dispatching, so no DUS can clamp.
+        def chunk(c, carry):
+            viol, store = carry
+            off = c * SL
+            rows = jnp.stack(
+                [
+                    lax.dynamic_slice(col, (off,), (SL,))
+                    for col in ecols
+                ],
+                axis=1,
+            )
+            if n_inv:
+                gids = n_visited + off + jnp.arange(
+                    SL, dtype=jnp.int32
                 )
-                if n_inv:
-                    gids = n_visited + off + jnp.arange(
-                        SL, dtype=jnp.int32
-                    )
-                    livec = (
-                        off + jnp.arange(SL, dtype=jnp.int32) < n_new
-                    )
-                    states = jax.vmap(layout.unpack)(rows)
-                    vnew = []
-                    for fn in inv_fns:
-                        ok = jax.vmap(fn)(states)
-                        bad = livec & ~ok
-                        vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
-                    viol = jnp.minimum(viol, jnp.stack(vnew))
-                store = lax.dynamic_update_slice(
-                    store, rows.reshape(SL * W),
-                    ((woff + off) * W,),
+                livec = (
+                    off + jnp.arange(SL, dtype=jnp.int32) < n_new
                 )
-                return (viol, store)
+                states = jax.vmap(layout.unpack)(rows)
+                vnew = []
+                for fn in inv_fns:
+                    ok = jax.vmap(fn)(states)
+                    bad = livec & ~ok
+                    vnew.append(jnp.min(jnp.where(bad, gids, BIG)))
+                viol = jnp.minimum(viol, jnp.stack(vnew))
+            store = lax.dynamic_update_slice(
+                store, rows.reshape(SL * W),
+                ((woff + off) * W,),
+            )
+            return (viol, store)
 
-            n_chunks = jnp.minimum((n_new + SL - 1) // SL, C)
-            viol, rows_store = lax.fori_loop(
-                0, n_chunks, chunk, (viol, rows_store)
+        n_chunks = jnp.minimum((n_new + SL - 1) // SL, C)
+        viol, rows_store = lax.fori_loop(
+            0, n_chunks, chunk, (viol, rows_store)
+        )
+        parent_log = lax.dynamic_update_slice(
+            parent_log, par, (n_visited,)
+        )
+        lane_log = lax.dynamic_update_slice(
+            lane_log, lane, (n_visited,)
+        )
+        return (
+            rows_store, parent_log, lane_log, n_visited + n_new,
+            viol,
+        )
+
+    # ------------------------------------------- fused level megakernel
+
+    # fused stats-vector tail: [level_base, nf, w_off, n_lv, rows_ok,
+    # groups_left] between the standard [nv, dead, viol..., fpm] prefix
+    # and the RMAX per-level sizes
+    FUSED_TAIL = 6
+
+    def _fused_jit(self):
+        """The round-13 level megakernel: ONE dispatch walks flush
+        groups — and, on the ramp, whole level boundaries — of the BFS
+        inside a ``lax.while_loop``, chaining the identical traced
+        sub-functions the stage chain dispatches separately
+        (``_expand_body`` -> ``ops.fpset.flush_acc`` ->
+        ``ops.compact.compact_rows`` -> ``_append_body``) with every
+        buffer donated end-to-end.
+
+        Operands: ``(vk, ak, arows, rows, parent, lane, n_visited,
+        dead_gid, viol, fpm, level_base, nf, w_off, levels_left,
+        groups_left, row_base, rows_ok)``; returns the updated buffers
+        + state scalars + one packed int32 stats vector ``[nv, dead,
+        viol..., fpm..., level_base, nf, w_off, n_lv, rows_ok,
+        groups_left, lsizes[RMAX]]`` so the host's ONE fetch reads
+        everything (no separate stats dispatch).
+
+        The loop runs while (a) the host-granted group/level budgets
+        hold, (b) the next flush group's worst case fits the capacity
+        tiers (``nv + min(ACAP, live*A) <= VCAP`` etc. — on exhaustion
+        the host fetches, grows, and re-enters mid-level via
+        ``w_off``), and (c) at a level boundary: the frontier is
+        nonzero, no violation/deadlock was found, and — past the first
+        level of the dispatch — the new frontier still fits one expand
+        window (the ramp's early exit on frontier growth).  Per-level
+        sizes come back in ``lsizes`` so host-side accounting,
+        telemetry ``level`` records, checkpoint frames, and
+        ``PTT_FAULT`` sites replay exactly.  Discovery order is
+        identical to the stage chain state-for-state: same window
+        layout, same flush partition, same min-lane-wins dedup.
+
+        Backend note (BASELINE.md Round-13): XLA:CPU copies while-loop
+        carried buffers once per iteration (measured ~110 ms per
+        800 MB), so on the virtual CPU mesh a big-store shape pays a
+        per-group store copy the stage chain avoids — negligible at
+        test sizes, and the 253k differential still favors fused
+        there.  On the TPU backend loop carries alias in place (the
+        resident-BFS premise this kernel is built on)."""
+        key = (
+            "fused", self.TCAP, self.LCAP, self.PCAP,
+            self.compact_impl, self.fps_dense, self.fps_stages,
+            self.RMAX,
+        )
+        if key in self._jits:
+            return self._jits[key]
+        K, W, A, G = self.K, self.W, self.A, self.G
+        NCs, ACAP, APAD, FLUSH = self.NCs, self.ACAP, self.APAD, self.FLUSH
+        VCAP, LCAP, PCAP, SCAP = self.VCAP, self.LCAP, self.PCAP, self.SCAP
+        RMAX = self.RMAX
+        frontier_mode = self.rows_window == "frontier"
+        impl = self.compact_impl
+        ramp_t = jnp.int32(G)  # new-level batch threshold: one window
+        # write-capacity limits, trace-time constants per tier: the
+        # append's blind APAD window and the ACAP-wide log DUS must
+        # never clamp (reads are clamp-safe — masked by n_live)
+        plimit = jnp.int32(PCAP - APAD)
+        llimit = None if frontier_mode else jnp.int32(LCAP - APAD)
+
+        def step(*args):
+            vk = args[:K]
+            ak = args[K: 2 * K]
+            (arows, rows, parent, lane, n_visited, dead, viol, fpm,
+             level_base, nf, w_off, levels_left, groups_left,
+             row_base, rows_ok) = args[2 * K:]
+
+            def viol_found(viol, dead):
+                return jnp.any(viol < BIG) | (dead < BIG)
+
+            def cond(st):
+                (vk, ak, arows, rows, parent, lane, nv, dead, viol,
+                 fpm, lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
+                 n_lv) = st
+                live = nf - w_off  # frontier rows not yet expanded
+                gnew = jnp.where(
+                    live > ACAP // A, jnp.int32(ACAP),
+                    live * A,
+                )
+                fits = (
+                    (nv + gnew <= VCAP)
+                    & (nv <= plimit)
+                    & (nv < SCAP)
+                )
+                if llimit is not None:
+                    fits = fits & (nv <= llimit)
+                mid = (w_off > 0) & (w_off < nf)
+                fresh = (
+                    (w_off == 0)
+                    & (nf > 0)
+                    & (lv_left > 0)
+                    & ~viol_found(viol, dead)
+                    # ramp early-exit: only the dispatch's FIRST level
+                    # may exceed one expand window
+                    & ((n_lv == 0) | (nf <= ramp_t))
+                )
+                return (g_left > 0) & fits & (mid | fresh)
+
+            def body(st):
+                (vk, ak, arows, rows, parent, lane, nv, dead, viol,
+                 fpm, lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
+                 n_lv) = st
+                # expand FLUSH windows into the accumulator (windows
+                # past the frontier end produce SENTINEL lanes — the
+                # same masking the stage chain's partial fills rely on)
+                for w in range(FLUSH):
+                    f_off = w_off + jnp.int32(w * G)
+                    window = lax.dynamic_slice(
+                        rows, ((lb - row_base + f_off) * W,), (G * W,)
+                    )
+                    ak, arows, dead = self._expand_body(
+                        ak, arows, window, f_off, nf, dead, lb,
+                        jnp.int32(w * NCs),
+                    )
+                vk, n_new, flag, fpm = fpset.flush_acc(
+                    vk, ak, jnp.int32(ACAP), fpm,
+                    dense_rounds=self.fps_dense,
+                    stages=self.fps_stages, compact_impl=impl,
+                )
+                crows, idx = compact_ops.compact_rows(
+                    arows, flag, impl=impl
+                )
+                if frontier_mode:
+                    # per-group actual-occupancy check — exactly the
+                    # predicate the stage loop evaluates at its forced
+                    # pre-overflow fetch (monotone: once lost, lost)
+                    rows_ok = rows_ok & (
+                        nv - row_base + APAD <= LCAP
+                    )
+                rows, parent, lane, nv2, viol = self._append_body(
+                    rows, parent, lane, crows, idx, n_new, nv, viol,
+                    lb + w_off, jnp.bool_(False), row_base, rows_ok,
+                )
+                arows = crows  # recycled as the next group's buffer
+                w_off2 = w_off + jnp.int32(FLUSH * G)
+                g_left = g_left - 1
+                # level boundary?
+                done = w_off2 >= nf
+                size = nv2 - (lb + nf)
+                lsizes = jnp.where(
+                    done,
+                    lsizes.at[jnp.minimum(n_lv, RMAX - 1)].set(size),
+                    lsizes,
+                )
+                di = done.astype(jnp.int32)
+                n_lv = n_lv + di
+                lv_left = lv_left - di
+                lb = jnp.where(done, lb + nf, lb)
+                nf = jnp.where(done, size, nf)
+                w_off = jnp.where(done, jnp.int32(0), w_off2)
+                return (
+                    vk, ak, arows, rows, parent, lane, nv2, dead,
+                    viol, fpm, lb, nf, w_off, lv_left, g_left,
+                    rows_ok, lsizes, n_lv,
+                )
+
+            st = (
+                tuple(vk), tuple(ak), arows, rows, parent, lane,
+                n_visited, dead, viol, fpm, level_base, nf, w_off,
+                levels_left, groups_left, rows_ok,
+                jnp.zeros((RMAX,), jnp.int32), jnp.int32(0),
             )
-            parent_log = lax.dynamic_update_slice(
-                parent_log, par, (n_visited,)
-            )
-            lane_log = lax.dynamic_update_slice(
-                lane_log, lane, (n_visited,)
+            (vk, ak, arows, rows, parent, lane, nv, dead, viol, fpm,
+             lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
+             n_lv) = lax.while_loop(cond, body, st)
+            statsvec = jnp.concatenate(
+                [
+                    jnp.stack([nv, dead]), viol, fpm,
+                    jnp.stack(
+                        [
+                            lb, nf, w_off, n_lv,
+                            rows_ok.astype(jnp.int32), g_left,
+                        ]
+                    ),
+                    lsizes,
+                ]
             )
             return (
-                rows_store, parent_log, lane_log, n_visited + n_new,
-                viol,
+                *vk, *ak, arows, rows, parent, lane, nv, dead, viol,
+                fpm, statsvec,
             )
 
-        fn = ajit(step, donate_argnums=(0, 1, 2))
+        fn = ajit(step, donate_argnums=tuple(range(2 * K + 4)))
         self._jits[key] = fn
         return fn
 
@@ -1140,6 +1393,11 @@ class DeviceChecker:
         # offsets up to n, so the store must admit one full chunk past
         # the worst-case write start or the DUS would clamp and corrupt
         self._grow_store(bufs, n + self.SEED_CHUNK)
+        if self.fuse == "level":
+            # land on the unified fused staircase (SEED_CHUNK <= APAD,
+            # so this covers the guard above and keeps the first fused
+            # dispatch on a prewarmed tier triple)
+            self._grow_fused(bufs, n)
         if self.visited_impl == "fpset":
             merge = self._fpseed_merge_jit()
         else:
@@ -1273,11 +1531,31 @@ class DeviceChecker:
         pad = self.SHIFT_CW if self.rows_window == "frontier" else 0
         return self.LCAP * self.W + pad
 
+    @staticmethod
+    def _next_cap(cur: int, need: int, cap: int) -> int:
+        """The log/row tiers' doubling-with-clamp schedule as pure
+        arithmetic — one source of truth for the growers below AND the
+        fused prewarm's tier-triple enumeration (the walk must land on
+        exactly the tiers a run will reach)."""
+        need = min(need, cap)
+        while cur < need:
+            cur += min(cur, max(cap - cur, need - cur))
+        return cur
+
+    @staticmethod
+    def _next_table(tcap: int, need: int, cap: int) -> int:
+        """fpset doubling schedule (pure arithmetic twin of
+        ``_grow_visited``'s rehash loop): the table capacity after
+        growing until ``need`` states fit at load <= 1/2."""
+        while tcap // 2 < need and tcap // 2 < cap:
+            tcap *= 2
+        return tcap
+
     def _grow_logs(self, bufs, need: int):
         cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
-        need = min(need, cap)  # deterministic tiers (see _grow_visited)
-        while self.PCAP < need:
-            pad = min(self.PCAP, max(cap - self.PCAP, need - self.PCAP))
+        target = self._next_cap(self.PCAP, need, cap)
+        while self.PCAP < target:
+            pad = min(self.PCAP, target - self.PCAP)
             bufs["parent"] = jnp.concatenate(
                 [bufs["parent"], jnp.zeros((pad,), jnp.int32)]
             )
@@ -1297,13 +1575,54 @@ class DeviceChecker:
         # plus one blind append window) so a preset near-SCAP store is
         # never forced to a wasteful next power of two
         cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
-        need = min(need, cap)  # deterministic tiers (see _grow_visited)
-        while self.LCAP < need:
-            pad = min(self.LCAP, max(cap - self.LCAP, need - self.LCAP))
+        target = self._next_cap(self.LCAP, need, cap)
+        while self.LCAP < target:
+            pad = min(self.LCAP, target - self.LCAP)
             bufs["rows"] = jnp.concatenate(
                 [bufs["rows"], jnp.zeros((pad * self.W,), jnp.uint32)]
             )
             self.LCAP += pad
+
+    def _grow_fused(self, bufs, need_states: int):
+        """Unified growth for the fused path: every fused-mode growth
+        site sizes visited + store/logs from ONE need, so the
+        (TCAP, LCAP, PCAP) tier triple is a single deterministic
+        staircase of ``need_states`` — which is what lets
+        ``warmup(tiers=True)`` pre-compile every megakernel tier a run
+        can reach (``_fused_tier_triples`` walks the same arithmetic).
+        """
+        self._grow_visited(bufs, need_states + self.ACAP)
+        self._grow_store(bufs, need_states + self.APAD)
+
+    def _fused_tier_triples(self):
+        """Every (TCAP, VCAP, LCAP, PCAP) the unified fused growth
+        schedule can reach from the CURRENT tiers, in order — pure
+        arithmetic over the same ``_next_cap``/``_next_table``
+        formulas the growers execute."""
+        tcap, vcap = self.TCAP, self.VCAP
+        lcap, pcap = self.LCAP, self.PCAP
+        capv = max(self.SCAP + self.ACAP, self.ACAP * 2)
+        capl = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        frontier = self.rows_window == "frontier"
+        out = [(tcap, vcap, lcap, pcap)]
+        while True:
+            # the smallest need that grows ANY dimension
+            cands = []
+            if vcap < capv:
+                cands.append(vcap - self.ACAP + 1)
+            if pcap < capl:
+                cands.append(pcap - self.APAD + 1)
+            if not frontier and lcap < capl:
+                cands.append(lcap - self.APAD + 1)
+            if not cands:
+                return out
+            need = max(min(cands), 1)
+            tcap = self._next_table(tcap, need + self.ACAP, capv)
+            vcap = tcap // 2
+            pcap = self._next_cap(pcap, need + self.APAD, capl)
+            if not frontier:
+                lcap = self._next_cap(lcap, need + self.APAD, capl)
+            out.append((tcap, vcap, lcap, pcap))
 
     # --------------------------------------------------------------- run
 
@@ -1324,15 +1643,21 @@ class DeviceChecker:
         save = (self.TCAP if self.visited_impl == "fpset" else None,
                 self.VCAP, self.LCAP, self.PCAP)
         cap = max(self.SCAP + self.ACAP, self.ACAP * 2)
+        fused = self.fuse == "level"
         if self.visited_impl == "fpset":
             while self.VCAP < cap:
                 # the growth path's exact sequence: rehash AT the
-                # current tier (old -> doubled), then flush at the new
+                # current tier (old -> doubled), then flush at the new.
+                # Fused mode never dispatches the standalone flush
+                # mid-run (the megakernel owns it — the triple walk
+                # below covers its tiers), so only rehash compiles here
                 out = self._rehash_jit()(*fpset.empty_cols(self.TCAP, K))
                 drain(out)
                 del out
                 self.TCAP *= 2
                 self.VCAP = self.TCAP // 2
+                if fused:
+                    continue
                 ak = tuple(
                     jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
                     for _ in range(K)
@@ -1358,8 +1683,11 @@ class DeviceChecker:
                 drain(out)
                 del vk, ak, out
         # row/log tiers grow only in rows_window="all" (frontier mode
-        # fixes the window and presizes the logs to SCAP up front)
-        if self.rows_window == "all":
+        # fixes the window and presizes the logs to SCAP up front).
+        # Fused mode skips the stage slice/append tier compiles for
+        # the same reason as the flush above — the megakernel triple
+        # walk below owns every store tier its run can touch.
+        if self.rows_window == "all" and not fused:
             capL = max(self.SCAP + self.APAD, self.NCs + self.APAD)
             n_inv = len(self.invariant_names)
             viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
@@ -1385,6 +1713,97 @@ class DeviceChecker:
         (tc, self.VCAP, self.LCAP, self.PCAP) = save
         if tc is not None:
             self.TCAP = tc
+        if fused:
+            # walk the UNIFIED fused growth staircase (one need drives
+            # every dimension — see _grow_fused) and compile the level
+            # megakernel at each reachable (TCAP, LCAP, PCAP) triple;
+            # run-time tier crossings then re-enter a prewarmed program
+            n_inv = len(self.invariant_names)
+            viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
+            for tcap, vcap, lcap, pcap in self._fused_tier_triples():
+                self.TCAP, self.VCAP = tcap, vcap
+                self.LCAP, self.PCAP = lcap, pcap
+                key = (
+                    "fused", tcap, lcap, pcap, self.compact_impl,
+                    self.fps_dense, self.fps_stages, self.RMAX,
+                )
+                if key in self._jits:
+                    continue  # the entry triple compiled in warmup()
+                out = self._warm_fused(viol0)
+                drain(out)
+                del out
+            (tc, self.VCAP, self.LCAP, self.PCAP) = save
+            if tc is not None:
+                self.TCAP = tc
+            # the INIT path still dispatches the stage chain, at the
+            # tier its growth reaches (n_initial + one accumulator /
+            # append window — model-known here): compile the two
+            # tier-keyed stage programs at exactly that tier so a warm
+            # submit stays zero-compile (the r11 service contract)
+            n_init = int(getattr(self.model, "n_initial", 0) or 0)
+            capl = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+            self.TCAP = self._next_table(
+                self.TCAP, n_init + self.ACAP, cap
+            )
+            self.VCAP = self.TCAP // 2
+            self.PCAP = self._next_cap(
+                self.PCAP, n_init + self.APAD, capl
+            )
+            if self.rows_window == "all":
+                self.LCAP = self._next_cap(
+                    self.LCAP, n_init + self.APAD, capl
+                )
+            if (
+                "fpflush", self.TCAP, self.compact_impl,
+                self.fps_dense, self.fps_stages,
+            ) not in self._jits:
+                ak = tuple(
+                    jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
+                    for _ in range(K)
+                )
+                out = self._fpflush_jit()(
+                    *fpset.empty_cols(self.TCAP, K), *ak,
+                    jnp.int32(0), z((FPM_N,), jnp.int32),
+                )
+                drain(out)
+                del ak, out
+            if ("append", self.LCAP, self.PCAP) not in self._jits:
+                app = self._append_jit()(
+                    z((self._rows_len(),), jnp.uint32),
+                    z((self.PCAP,), jnp.int32),
+                    z((self.PCAP,), jnp.int32),
+                    z((self.W, self.ACAP), jnp.uint32),
+                    z((self.ACAP,), jnp.int32),
+                    jnp.int32(0), jnp.int32(0), viol0, jnp.int32(0),
+                    jnp.bool_(False), jnp.int32(0), jnp.bool_(True),
+                )
+                drain(app)
+                del app
+            (tc, self.VCAP, self.LCAP, self.PCAP) = save
+            if tc is not None:
+                self.TCAP = tc
+
+    def _warm_fused(self, viol0):
+        """Compile the level megakernel at the CURRENT tier triple on
+        dummy buffers — ``nf=0`` with zero budgets, so the while_loop
+        exits immediately and the dummies cost one allocation, not a
+        walk."""
+        z = jnp.zeros
+        K = self.K
+        return self._fused_jit()(
+            *fpset.empty_cols(self.TCAP, K),
+            *tuple(
+                jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
+                for _ in range(K)
+            ),
+            z((self.W, self.ACAP), jnp.uint32),
+            z((self._rows_len(),), jnp.uint32),
+            z((self.PCAP,), jnp.int32),
+            z((self.PCAP,), jnp.int32),
+            jnp.int32(0), BIG, viol0, z((FPM_N,), jnp.int32),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0), jnp.int32(0), jnp.bool_(True),
+        )
 
     def warmup(self, seed: bool = False, tiers: bool = True) -> float:
         """Compile every hot-path jit at the current tiers on dummy data
@@ -1427,21 +1846,28 @@ class DeviceChecker:
         drain(out)
         mark("init")
         ak, arows = out[:K], out[K]
-        rows_buf = z((self._rows_len(),), jnp.uint32)
-        window = self._slice_jit()(rows_buf, jnp.int32(0))
-        if self.rows_window == "frontier":
-            drain(
-                self._shift_jit()(rows_buf, jnp.int32(0), jnp.int32(0))
+        if self.rows_window == "frontier" or self.fuse == "stage":
+            rows_buf = z((self._rows_len(),), jnp.uint32)
+            if self.fuse == "stage":
+                window = self._slice_jit()(rows_buf, jnp.int32(0))
+            if self.rows_window == "frontier":
+                drain(
+                    self._shift_jit()(
+                        rows_buf, jnp.int32(0), jnp.int32(0)
+                    )
+                )
+            del rows_buf
+        if self.fuse == "stage":
+            # the standalone expand program is a stage-chain dispatch;
+            # fused mode compiles the expand body inside the megakernel
+            out = self._expand_jit()(
+                *ak, arows, window, jnp.int32(0), jnp.int32(0), BIG,
+                jnp.int32(0), jnp.int32(0),
             )
-        del rows_buf
-        out = self._expand_jit()(
-            *ak, arows, window, jnp.int32(0), jnp.int32(0), BIG,
-            jnp.int32(0), jnp.int32(0),
-        )
-        drain(out)
-        mark("expand")
-        ak, arows = out[:K], out[K]
-        del window
+            drain(out)
+            mark("expand")
+            ak, arows = out[:K], out[K]
+            del window
         fpmode = self.visited_impl == "fpset"
         seed_tbl = None
         if fpmode:
@@ -1497,6 +1923,9 @@ class DeviceChecker:
             )
         )
         mark("misc")
+        if self.fuse == "level":
+            drain(self._warm_fused(viol0))
+            mark("fused")
         if seed:
             write = self._seed_write_jit()
             if fpmode:
@@ -1579,6 +2008,12 @@ class DeviceChecker:
             self.last_stats.get("stage_compact_s", 0.0)
         )
         self._resume_meta = {}
+        # per-run dispatch accounting baseline (the stage counters in
+        # last_stats are lifetime-cumulative): dispatches_per_level in
+        # the result reports THIS run's dispatch/level ratio, and
+        # fuse_levels counts THIS run's megakernel-closed levels
+        self._disp_prev = self._dispatch_total()
+        self.last_stats.pop("fuse_levels", None)
         self._restore_s = 0.0  # frame-restore wall of THIS run (resume)
         self._xprof_on = False
         self._xprof_done = False
@@ -1654,6 +2089,8 @@ class DeviceChecker:
             device=dev,
             visited_impl=self.visited_impl,
             compact_impl=self.compact_impl,
+            fuse=self.fuse,
+            fuse_group=self.RMAX,
             config_sig=self._config_sig(),
             wall_unix=round(time.time(), 3),
             max_states=self.SCAP,
@@ -1783,6 +2220,7 @@ class DeviceChecker:
             self._emit_metrics(
                 t0, len(level_sizes), 0, int(stats[0]),
                 level_sizes[-1] if level_sizes else 0,
+                partial=True,
             )
             fv = self._first_viol(stats)
             gid = fv[1] if fv is not None else None
@@ -1835,25 +2273,32 @@ class DeviceChecker:
             t0, bufs, st, rb, level_sizes, level_base, nf, stats
         )
 
-    def _fetch(self, st):
+    def _fetch(self, st, vec=None):
         """One stats fetch (the only hot-path host sync): returns the
         numpy stats vector and fail-stops on fpset probe overflow.
         Every zero-sync device counter (:data:`FPM_N`) rides this
         fetch; the heartbeat snapshot and the per-flush telemetry
-        deltas update here — nothing else ever syncs."""
+        deltas update here — nothing else ever syncs.  ``vec`` is an
+        already-dispatched stats vector (the fused megakernel returns
+        one, so a fused level pays NO separate stats dispatch); its
+        prefix layout matches ``_stats_jit`` and any tail beyond the
+        fpm block is returned untouched for the caller to parse."""
         tf = time.time()
-        stats_fn = self._stats_jit()
         fpmode = self.visited_impl == "fpset"
-        if fpmode:
+        if vec is not None:
+            out = np.asarray(vec)
+        elif fpmode:
             out = np.asarray(
-                stats_fn(
+                self._stats_jit()(
                     st["n_visited"], st["dead_gid"], st["viol"],
                     st["fpm"],
                 )
             )
         else:
             out = np.asarray(
-                stats_fn(st["n_visited"], st["dead_gid"], st["viol"])
+                self._stats_jit()(
+                    st["n_visited"], st["dead_gid"], st["viol"]
+                )
             )
         self._host_wait_s += time.time() - tf
         self._fetch_n += 1
@@ -1861,7 +2306,7 @@ class DeviceChecker:
         self._snap["distinct_states"] = nv
         if fpmode:
             n_inv = len(self.invariant_names)
-            self._last_fpm = out[2 + n_inv:]
+            self._last_fpm = out[2 + n_inv: 2 + n_inv + FPM_N]
             self._snap["occupancy"] = nv / max(self.TCAP, 1)
             if len(self._last_fpm) >= 4:
                 # TLC's "states generated": candidate lanes examined
@@ -2161,8 +2606,47 @@ class DeviceChecker:
                         t0, nv, level_sizes, bufs, truncated=True,
                         stop_reason="row_window",
                     )
-            else:
+            elif self.fuse == "stage":
+                # fused mode sizes all stores from one unified need at
+                # dispatch time (_grow_fused) so the tier triple stays
+                # on the prewarmed staircase
                 self._grow_store(bufs, level_base + nf + self.G)
+            if self.fuse == "level":
+                (
+                    stats, nv, level_base, nf, stop, partial,
+                ) = self._fused_level_pass(
+                    t0, bufs, st, rb, level_sizes, level_base, nf, nv,
+                    stats,
+                )
+                if stop:
+                    reason = self._stop_reason(stats, t0) or {
+                        "truncated": True, "stop_reason": "hbm"
+                    }
+                    if (
+                        reason.get("truncated")
+                        and not self._bufs_poisoned
+                    ):
+                        # mid-level stop: the frame rewinds to the
+                        # level boundary, exactly like the stage path
+                        self._save_frame(
+                            bufs, st, rb,
+                            level_sizes[:-1] if partial
+                            else list(level_sizes),
+                            level_base, nf, nv, t0,
+                        )
+                    return self._result(
+                        t0, nv, level_sizes, bufs, **reason
+                    )
+                if (
+                    self.checkpoint_path
+                    and nf
+                    and len(level_sizes) % self.checkpoint_every == 0
+                ):
+                    self._save_frame(
+                        bufs, st, rb, level_sizes, level_base, nf, nv,
+                        t0,
+                    )
+                continue
             stop = False
             pending = 0  # flushes dispatched since the last fetch
             w = 0  # accumulator windows filled since the last flush
@@ -2226,6 +2710,7 @@ class DeviceChecker:
                         self._emit_metrics(
                             t0, len(level_sizes) + 1,
                             nv - (level_base + nf), nv, nf,
+                            partial=True,
                         )
                         if self._stop_reason(stats, t0) is not None:
                             stop = True
@@ -2331,6 +2816,234 @@ class DeviceChecker:
                 self._save_frame(
                     bufs, st, rb, level_sizes, level_base, nf, nv, t0
                 )
+
+    # ------------------------------------------------------- fused pass
+
+    def _levels_cap(self, nf: int, levels_done: int) -> int:
+        """Max level boundaries one fused dispatch may cross — the
+        cost model's batching decision, auto from the frontier size
+        (the r10 ``--sweep-group`` pattern): ramp levels (frontier at
+        or below one expand window, rows_window="all" — the frontier
+        window's boundary shift is host-side) batch up to ``RMAX``
+        levels; steady-state levels run one per dispatch.  Capped so a
+        batch always ENDS on a due checkpoint boundary — frames,
+        suspend polls, and preemption checks keep their level-boundary
+        semantics."""
+        if self.rows_window != "all" or nf > self.G:
+            lv = 1
+        else:
+            lv = self.RMAX
+        if self.checkpoint_path:
+            lv = min(
+                lv,
+                self.checkpoint_every
+                - (levels_done % self.checkpoint_every),
+            )
+        return max(lv, 1)
+
+    def _groups_cap(self) -> int:
+        """Flush groups one fused dispatch may run.  Unbudgeted runs
+        are bounded by capacity and the level budget alone (whole
+        levels per dispatch); a time-budgeted run keeps a finite fetch
+        cadence so the budget check cannot blunt to whole-deep-level
+        granularity (still far coarser than the stage path's
+        per-``group`` syncs)."""
+        if self.time_budget_s is not None:
+            return max(8 * self.group, 32)
+        return 1 << 30
+
+    def _replay_flush_faults(self, st, fl_before: int):
+        """The megakernel ran its flushes in-device; fire the host
+        ``flush`` fault sites for exactly the flushes the device
+        counted (the fpm flush-counter delta), preserving the drills'
+        sequence numbering across the fused and stage paths.  An
+        injected ``fpset_fail`` lands in the device metrics and
+        fail-stops through the SAME fetch path a real stage overflow
+        takes."""
+        total = int(fpset.fpm_logical(self._last_fpm)[0])
+        fired_fail = False
+        for _ in range(total - fl_before):
+            self._flush_seq += 1
+            kinds = faults.poll("flush", self._flush_seq)
+            if "oom" in kinds:
+                raise faults.oom_error("flush", self._flush_seq)
+            if "fpset_fail" in kinds:
+                fired_fail = True
+        if fired_fail:
+            st["fpm"] = st["fpm"] + jnp.asarray(
+                [0, 0, 1] + [0] * (FPM_N - 3), jnp.int32
+            )
+            self._fetch(st)  # realizes the fail-stop immediately
+
+    def _fused_level_pass(
+        self, t0, bufs, st, rb, level_sizes, level_base, nf, nv, stats
+    ):
+        """Advance the BFS from the current level boundary through
+        fused megakernel dispatches until the next boundary the host
+        must act on (growth between segments happens here; per-level
+        accounting, telemetry, and fault sites replay from the
+        kernel's returned level sizes).  Returns ``(stats, nv,
+        level_base, nf, stop, partial)`` — ``partial`` flags a
+        mid-level stop whose last ``level_sizes`` entry is the
+        in-progress level's partial count (frame rewind semantics
+        identical to the stage path)."""
+        K = self.K
+        n_inv = len(self.invariant_names)
+        stop = False
+        partial = False
+        w_off = 0
+        try:
+            kinds = faults.poll("level", len(level_sizes) + 1)
+            if "oom" in kinds:
+                raise faults.oom_error("level", len(level_sizes) + 1)
+            while True:
+                # pre-dispatch growth from ONE unified need (keeps the
+                # tier triple on the prewarmed staircase); headroom
+                # freezes to one accumulator after an HBM recovery
+                head = (
+                    self.ACAP
+                    if self.rec.headroom_frozen
+                    else (self.group + 1) * self.ACAP
+                )
+                self._grow_fused(bufs, nv + head)
+                lv_cap = self._levels_cap(nf, len(level_sizes))
+                fl_before = (
+                    int(fpset.fpm_logical(self._last_fpm)[0])
+                    if self._last_fpm is not None
+                    else 0
+                )
+                out = self._stage_mark(
+                    "fused",
+                    self._fused_jit()(
+                        *bufs["vk"], *bufs["ak"], bufs["arows"],
+                        bufs["rows"], bufs["parent"], bufs["lane"],
+                        st["n_visited"], st["dead_gid"], st["viol"],
+                        st["fpm"], jnp.int32(level_base),
+                        jnp.int32(nf), jnp.int32(w_off),
+                        jnp.int32(lv_cap),
+                        jnp.int32(self._groups_cap()),
+                        jnp.int32(rb["row_base"]),
+                        jnp.bool_(rb["rows_ok"]),
+                    ),
+                )
+                bufs["vk"] = out[:K]
+                bufs["ak"] = out[K: 2 * K]
+                (
+                    bufs["arows"], bufs["rows"], bufs["parent"],
+                    bufs["lane"], st["n_visited"], st["dead_gid"],
+                    st["viol"], st["fpm"],
+                ) = out[2 * K: 2 * K + 8]
+                # the kernel's packed stats vector IS the fetch — a
+                # fused level pays 1 dispatch + 1 fetch, nothing else
+                stats = self._fetch(st, vec=out[2 * K + 8])
+                nv = int(stats[0])
+                tail = stats[2 + n_inv + FPM_N:]
+                lb2, nf2, w_off2, n_lv, rows_ok_i = (
+                    int(x) for x in tail[:5]
+                )
+                sizes = [
+                    int(x)
+                    for x in tail[
+                        self.FUSED_TAIL: self.FUSED_TAIL + n_lv
+                    ]
+                ]
+                if self.rows_window == "frontier":
+                    rb["rows_ok"] = bool(rows_ok_i)
+                self._replay_flush_faults(st, fl_before)
+                self.tel.emit(
+                    "fuse",
+                    levels=n_lv,
+                    dispatches=1,
+                    flushes=int(fpset.fpm_logical(self._last_fpm)[0])
+                    - fl_before,
+                    frontier=int(nf),
+                )
+                # ---- per-level accounting replay (the kernel's
+                # lsizes): level records, log lines, and PTT_FAULT
+                # level sites fire for every batched level, in order
+                prev_nf = nf
+                cum = level_base + nf
+                for k, sz in enumerate(sizes):
+                    if sz == 0:
+                        # a level that added nothing ends the search
+                        # (nf=0 exits the kernel right after); the
+                        # stage path never appends empty levels either
+                        continue
+                    if k > 0:
+                        kinds = faults.poll(
+                            "level", len(level_sizes) + 1
+                        )
+                        if "oom" in kinds:
+                            level_base, nf = lb2, nf2
+                            raise faults.oom_error(
+                                "level", len(level_sizes) + 1
+                            )
+                    cum += sz
+                    level_sizes.append(sz)
+                    self._emit_metrics(
+                        t0, len(level_sizes), sz, cum, prev_nf
+                    )
+                    wall = time.time() - t0
+                    self._log(
+                        f"level {len(level_sizes)}: +{sz} "
+                        f"(total {cum}, {cum/max(wall,1e-9):.0f} st/s)"
+                    )
+                    prev_nf = sz
+                if n_lv:
+                    self.last_stats["fuse_levels"] = (
+                        self.last_stats.get("fuse_levels", 0) + n_lv
+                    )
+                level_base, nf = lb2, nf2
+                if w_off2 == 0:
+                    break  # at a boundary/terminal — the outer loop acts
+                if sizes:
+                    # a level that STARTED inside this dispatch is now
+                    # mid-flight: its level site fires here (the pass
+                    # entry only covered the dispatch's first level)
+                    kinds = faults.poll("level", len(level_sizes) + 1)
+                    if "oom" in kinds:
+                        raise faults.oom_error(
+                            "level", len(level_sizes) + 1
+                        )
+                w_off = w_off2
+                # mid-level segment boundary: progress anchor + stop
+                # check, then grow at the loop top and re-enter
+                self._emit_metrics(
+                    t0, len(level_sizes) + 1,
+                    nv - (level_base + nf), nv, nf, partial=True,
+                )
+                if self._stop_reason(stats, t0) is not None:
+                    stop = True
+                    partial = True
+                    break
+        except Exception as e:  # noqa: BLE001
+            if not recovery.is_resource_exhausted(e):
+                raise
+            if self._can_recover():
+                raise recovery.HbmExhausted(
+                    nv, list(level_sizes), repr(e)
+                )
+            self._log(
+                f"HBM exhausted mid-level: truncating ({e!r:.120})"
+            )
+            self._bufs_poisoned = True
+            stop = True
+        if stop:
+            if partial and not self._bufs_poisoned:
+                # mirror the stage tail: the in-progress level's
+                # partial count rides as the last diameter entry (it
+                # re-derives on resume by dedup idempotence)
+                level_count = nv - (level_base + nf)
+                level_sizes.append(max(level_count, 0))
+                self._emit_metrics(
+                    t0, len(level_sizes), level_count, nv, nf
+                )
+            elif self._bufs_poisoned:
+                level_count = nv - (level_base + nf)
+                if level_count > 0:
+                    level_sizes.append(level_count)
+                    partial = True
+        return stats, nv, level_base, nf, stop, partial
 
     # ------------------------------------------------ checkpoint/resume
 
@@ -2639,16 +3352,22 @@ class DeviceChecker:
                 best = (name, g)
         return best
 
-    def _emit_metrics(self, t0, level, level_count, nv, nf):
+    def _emit_metrics(self, t0, level, level_count, nv, nf,
+                      partial: bool = False):
         """Every record is kept (duplicate state counts included) —
         rate consumers skip zero-delta tails themselves (bench.py
-        sustained_rates)."""
+        sustained_rates).  ``partial=True`` marks intra-level anchors
+        (mid-level segment fetches, the seed handoff) so v6 stream
+        consumers can separate them from level-boundary records — the
+        fused-run validator holds only boundary records to the
+        strictly-increasing / sizes-match-result contract."""
         wall = time.time() - t0
         self._snap.update(
             level=level, frontier=int(nf), distinct_states=int(nv)
         )
         self.tel.emit(
             "level",
+            **({"partial": True} if partial else {}),
             level=level,
             new_states=int(level_count),
             distinct_states=int(nv),
@@ -2757,8 +3476,18 @@ class DeviceChecker:
                         max(1.0 - nv / vl, 0.0), 4
                     ) if vl else None,
                 )
+        # fusion telemetry (r13): this run's total dispatches per BFS
+        # level — the regression-gate signal (steady-state fused levels
+        # read 1.0 + the init/ramp amortization; the stage chain reads
+        # the full per-stage chain length)
+        self.last_stats["dispatches_per_level"] = round(
+            (self._dispatch_total() - getattr(self, "_disp_prev", 0))
+            / max(len(level_sizes), 1),
+            2,
+        )
         # survivability telemetry for bench artifacts (r7/r8/r9)
         self.last_stats.update(
+            fuse=self.fuse,
             compact_impl=self.compact_impl,
             hbm_recovered=self._hbm_recovered,
             ckpt_frames=self._ckpt_frames,

@@ -23,8 +23,13 @@ from typing import Dict, List, Optional, Tuple
 
 # canonical stage order for the per-stage table (matches BASELINE.md;
 # r10 splits the append's stream compaction into its own "compact"
-# dispatch, so the old append column reads as compact + append)
-STAGE_ORDER = ("expand", "flush", "compact", "append", "init", "shift")
+# dispatch, so the old append column reads as compact + append; r13
+# fuses the whole per-level chain into the "fused" megakernel — a
+# fused run's expand/flush/compact/append columns show only the init
+# path's dispatches)
+STAGE_ORDER = (
+    "fused", "expand", "flush", "compact", "append", "init", "shift",
+)
 
 
 def load_events(path: str) -> Tuple[List[dict], List[str]]:
@@ -225,12 +230,26 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
         out.setdefault("hbm_recovered", len(recov))
     if "compact_impl" in stats:
         out["compact_impl"] = stats["compact_impl"]
+    # level fusion (r13): the dispatch-economy keys — megakernel
+    # dispatches, levels it closed, and the run's dispatches/level
+    for k in ("fuse", "dispatches_per_level", "stage_fused_n",
+              "fuse_levels"):
+        if k in stats:
+            out[k] = stats[k]
+    fuses = [e for e in events if e.get("event") == "fuse"]
+    if fuses and "stage_fused_n" not in out:
+        out["stage_fused_n"] = sum(
+            int(e.get("dispatches", 0)) for e in fuses
+        )
+        out["fuse_levels"] = sum(int(e.get("levels", 0)) for e in fuses)
     hd = header(events)
     if hd is not None:
         out["engine"] = hd.get("engine")
         out["visited_impl"] = hd.get("visited_impl")
         if "compact_impl" not in out and hd.get("compact_impl"):
             out["compact_impl"] = hd.get("compact_impl")
+        if "fuse" not in out and hd.get("fuse"):
+            out["fuse"] = hd.get("fuse")
         out["run_id"] = hd.get("run_id")
     return out
 

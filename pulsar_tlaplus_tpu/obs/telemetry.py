@@ -58,10 +58,19 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # level boundary of the resumed slice: frame load + device rebuild =
 # the context-switch restore cost the ROADMAP serve bench asks for);
 # ``obs/trace.py`` renders suspend->resume gaps as explicit
-# "context-switch" spans from exactly these fields.  Validators accept
-# <= SCHEMA_VERSION and hold a record only to the fields its OWN
-# version requires (FIELD_SINCE) — pre-r10 streams stay valid.
-SCHEMA_VERSION = 5
+# "context-switch" spans from exactly these fields.  v6 (round 13, the
+# fused level megakernel): the device engine emits one ``fuse`` record
+# per megakernel dispatch (levels closed, flushes run), its run header
+# carries ``fuse``/``fuse_group``, intra-level ``level`` records are
+# tagged ``partial`` so boundary records stay unambiguous, and the
+# result stats carry ``stage_fused_n``/``dispatches_per_level``; the
+# validator additionally cross-checks a fused run's boundary level
+# records against the result's ``level_sizes`` (strictly increasing
+# levels, per-level sizes summing to the distinct-state count).
+# Validators accept <= SCHEMA_VERSION and hold a record only to the
+# fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
+# valid.
+SCHEMA_VERSION = 6
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -96,6 +105,11 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("job_result", "status"): 4,
     ("job_cancel", "job_id"): 4,
     ("serve", "action"): 4,
+    # v6: the fused level megakernel's per-dispatch record (round 13).
+    # The event is NEW at v6; gating its fields keeps hypothetical
+    # older streams using the name validator-clean.
+    ("fuse", "levels"): 6,
+    ("fuse", "dispatches"): 6,
 }
 EVENTS: Dict[str, Tuple[str, ...]] = {
     # run lifecycle
@@ -114,6 +128,10 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # dispatch counter, tagged with the active impl (logshift|sort);
     # PTT_STAGE_TIMING runs add ``drain_s`` for the per-stage table
     "compact": ("dispatches", "impl"),
+    # fused level megakernel (r13): one record per dispatch — levels
+    # closed inside the dispatch (>1 = a ramp batch) and the flush
+    # groups it ran; the dispatch-count regression signal
+    "fuse": ("levels", "dispatches"),
     # survivability (r9: ``retries`` is the frame writer's
     # transient-failure retry count — the ckpt_retries breadcrumb)
     "ckpt_frame": (

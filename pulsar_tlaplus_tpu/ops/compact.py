@@ -185,6 +185,21 @@ def logshift_compact(
     return tuple(out), idx
 
 
+def compact_rows(
+    arows: jax.Array, flag_keep: jax.Array, impl: str = "logshift"
+) -> Tuple[jax.Array, jax.Array]:
+    """Compact a word-major ``[W, N]`` packed-row matrix to the front
+    where ``flag_keep`` (uint32 0/1) is set, preserving original order
+    — the device append's stream-compaction step, shared as a traced
+    sub-function by the per-stage ``_compact_jit`` and the fused level
+    megakernel (round 13).  Returns ``(compacted [W, N], idx)`` where
+    ``idx[j]`` is the original lane of compacted position ``j``."""
+    drop = flag_keep ^ jnp.uint32(1)
+    cols = tuple(arows[j] for j in range(arows.shape[0]))
+    ccols, idx = compact_by_flag(drop, cols, impl=impl)
+    return jnp.stack(ccols), idx
+
+
 def compact_by_flag(
     drop: jax.Array,
     cols,

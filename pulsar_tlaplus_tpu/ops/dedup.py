@@ -213,7 +213,7 @@ def compact_by_flag(drop, cols, chunk: int = 5):
     (original order preserved), without a wide multi-operand sort.
 
     XLA sort COMPILE time explodes superlinearly in operand count on
-    the TPU tunnel backend (measured, scripts/profile_prims2.py: 2 ops
+    the TPU tunnel backend (measured, scripts/profile.py prims: 2 ops
     12 s, 6 ops 33 s, 21 ops 245 s, 21 stable 435 s — the round-3
     append's 22-operand stable sort was 84% of the 886 s bench warmup)
     while RUN time grows sublinearly.  So: ONE u32 key ``drop << 31 |

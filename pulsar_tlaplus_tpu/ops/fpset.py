@@ -427,6 +427,44 @@ def _merge_new(is_new, stage_new, stage_ids, nq):
     return is_new.at[tgt].set(True, mode="drop")
 
 
+def flush_acc(
+    tcols: Tuple[jax.Array, ...],
+    kcols: Tuple[jax.Array, ...],
+    n_acc,
+    fpm: jax.Array,
+    dense_rounds: Optional[int] = None,
+    stages=None,
+    compact_impl: str = "logshift",
+):
+    """One accumulator flush as a traced sub-function (round 13): mask
+    the live prefix, probe-or-insert, count the new states, and ride
+    the metrics vector — ``(tcols', n_new, flag_acc, fpm')`` with
+    ``flag_acc`` the uint32 new-state flags in ORIGINAL lane order.
+
+    This is the body the device engine's ``_fpflush_jit`` always ran;
+    factoring it here lets the fused level megakernel chain it inside
+    one dispatch while the per-stage jit keeps calling the identical
+    trace — bit-for-bit the same flush either way.  Lanes past
+    ``n_acc`` (a stale tail from a previous fill) and all-SENTINEL
+    lanes (masked expand output) are invalid; min-lane-wins keeps the
+    sort-merge flush's discovery order.
+    """
+    nq = kcols[0].shape[0]
+    lanei = jnp.arange(nq, dtype=jnp.int32)
+    amask = lanei < n_acc
+    valid = amask & ~all_sentinel(kcols)
+    is_new, tcols2, n_failed, rounds = lookup_or_insert(
+        tcols, kcols, valid,
+        dense_rounds=dense_rounds, stages=stages,
+        compact_impl=compact_impl,
+    )
+    n_new = jnp.sum(is_new.astype(jnp.int32))
+    fpm2 = fpm_update(
+        fpm, rounds, n_failed, jnp.sum(valid.astype(jnp.int32))
+    )
+    return tcols2, n_new, is_new.astype(jnp.uint32), fpm2
+
+
 def lookup(
     tcols: Tuple[jax.Array, ...],
     kcols: Tuple[jax.Array, ...],

@@ -29,12 +29,18 @@ to their fields only at v3 via FIELD_SINCE, so pre-r10 streams stay
 validator-clean; r11: the checker daemon's ``job_*`` + ``serve``
 lifecycle events, required fields gated at v4; r12: ``job_suspend``
 carries ``slice_wall_s`` and ``job_resume`` carries ``restore_s`` —
-the measured context-switch halves — gated at v5).  ``--trace``
+the measured context-switch halves — gated at v5; r13: the device
+engine's ``fuse`` megakernel records, gated at v6, and a fused-run
+CROSS-CHECK — every run whose header declares ``fuse: "level"`` must
+carry strictly increasing boundary ``level`` records whose per-level
+sizes match the result's ``level_sizes`` and, on clean runs, sum to
+its distinct-state count).  ``--trace``
 validates an exported Perfetto trace file's event structure instead
 (obs/trace.py).  Bench rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
-``ckpt_retries``, >= 5 additionally ``compact_impl``.
+``ckpt_retries``, >= 5 additionally ``compact_impl``, >= 6
+additionally ``fuse`` + ``dispatches_per_level``.
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -76,6 +82,59 @@ BENCH_KEYS_V3 = BENCH_KEYS_V2 + (
 BENCH_KEYS_V4 = BENCH_KEYS_V3 + ("ckpt_retries",)
 # v5 (r10): the stream-compaction impl (logshift|sort differential)
 BENCH_KEYS_V5 = BENCH_KEYS_V4 + ("compact_impl",)
+# v6 (r13): the level-fusion mode and the run's dispatch economy (the
+# fused-vs-stage differential headline)
+BENCH_KEYS_V6 = BENCH_KEYS_V5 + ("fuse", "dispatches_per_level")
+
+
+def _check_fused_levels(path: str, runs: dict) -> List[str]:
+    """v6 fused-run cross-check: for every run whose header declares
+    ``fuse: "level"``, the non-``partial`` (boundary) ``level`` records
+    must carry strictly increasing levels whose ``new_states`` match
+    the result's ``level_sizes`` entry for that level — and on a clean
+    (non-truncated, non-violation) run the per-level sizes must sum to
+    the result's distinct-state count.  This is what pins the fused
+    megakernel's host-side per-level accounting replay: a batch that
+    dropped, duplicated, or misordered a level record fails here."""
+    errors: List[str] = []
+    for rid, r in runs.items():
+        hd, res, levels = r["header"], r["result"], r["levels"]
+        if not hd or hd.get("fuse") != "level" or res is None:
+            continue
+        sizes = res.get("level_sizes")
+        prev = 0
+        for e in levels:
+            lv = e.get("level")
+            if not isinstance(lv, int):
+                continue
+            if lv <= prev:
+                errors.append(
+                    f"{path}: run {rid}: fused boundary level records "
+                    f"not strictly increasing ({lv} after {prev})"
+                )
+            prev = lv
+            if (
+                isinstance(sizes, list)
+                and 1 <= lv <= len(sizes)
+                and e.get("new_states") != sizes[lv - 1]
+            ):
+                errors.append(
+                    f"{path}: run {rid}: level {lv} record says "
+                    f"+{e.get('new_states')} but result.level_sizes"
+                    f"[{lv - 1}] is {sizes[lv - 1]}"
+                )
+        if (
+            isinstance(sizes, list)
+            and not res.get("truncated")
+            and not res.get("violation")
+            and sum(sizes) != res.get("distinct_states")
+        ):
+            errors.append(
+                f"{path}: run {rid}: fused level_sizes sum "
+                f"{sum(sizes)} != distinct_states "
+                f"{res.get('distinct_states')}"
+            )
+    return errors
 
 
 def validate_stream(path: str) -> List[str]:
@@ -83,6 +142,7 @@ def validate_stream(path: str) -> List[str]:
     errors: List[str] = []
     last_t: dict = {}
     last_seq: dict = {}
+    fused_runs: dict = {}
     n = 0
     try:
         f = open(path)
@@ -157,8 +217,21 @@ def validate_stream(path: str) -> List[str]:
                     errors.append(
                         f"{path}:{i}: {rec['event']} missing {miss}"
                     )
+            # collect per-run material for the v6 fused-run
+            # cross-check (boundary level records vs result sizes)
+            run = fused_runs.setdefault(
+                rec["run_id"],
+                {"header": None, "result": None, "levels": []},
+            )
+            if rec["event"] == "run_header":
+                run["header"] = rec
+            elif rec["event"] == "result":
+                run["result"] = rec
+            elif rec["event"] == "level" and not rec.get("partial"):
+                run["levels"].append(rec)
     if n == 0:
         errors.append(f"{path}: empty stream")
+    errors += _check_fused_levels(path, fused_runs)
     return errors
 
 
@@ -188,7 +261,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 5:
+    if schema >= 6:
+        required = BENCH_KEYS_V6
+    elif schema >= 5:
         required = BENCH_KEYS_V5
     elif schema >= 4:
         required = BENCH_KEYS_V4

@@ -1,0 +1,759 @@
+#!/usr/bin/env python
+"""One front-end for the real-chip profiling probes (round 13).
+
+The nine one-off ``scripts/profile_*.py`` probes accreted one per
+design round; this consolidates them into subcommands so the bench
+playbook has a single entry point and the probe idioms (chained
+dispatch timing on the ~130 ms tunnel, fetch-one-element barriers) live
+in one place:
+
+    python scripts/profile.py expand  [--mode timed|chained]
+    python scripts/profile.py prims   [--set v1|sorts|big|gather|all]
+    python scripts/profile.py stages  [--sub-batch-log2 19] [--run S]
+    python scripts/profile.py lsm     [--section sort|sort4|gather|scatter]
+    python scripts/profile.py bucket
+
+Mapping from the retired scripts:
+
+- ``profile_expand.py``   -> ``expand --mode timed`` (per-stage expand
+  breakdown, block_until_ready timing)
+- ``profile_expand2.py``  -> ``expand --mode chained`` (chained
+  dispatches subtract the tunnel RTT)
+- ``profile_prims.py``    -> ``prims --set v1`` (dedup primitive
+  candidates: sorts, gathers, scatter variants, searchsorted)
+- ``profile_prims2.py``   -> ``prims --set sorts|big|gather`` (the
+  round-4 sort/gather/scatter cost curves)
+- ``profile_stages.py``   -> ``stages`` (per-dispatch stage costs on
+  the CURRENT device engine — updated to the r10 compact split and the
+  r13 fused level megakernel; the old script predated both and called
+  retired jit signatures)
+- ``profile_stages5.py``  -> ``stages --run BUDGET_S`` (a budgeted
+  bench-shape run under PTT_STAGE_TIMING with the per-stage totals +
+  RTT-corrected estimates printed)
+- ``profile_lsm.py``      -> ``lsm`` (sort/gather/scatter/DUS at
+  round-3 LSM shapes; one section per process — the buffer sets are
+  mutually incompatible in HBM)
+- ``profile_bucket.py``   -> ``bucket`` (bucketized-hash row gathers,
+  unique scatter, segmented rank)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_ROOT, ".jax_cache")
+)
+sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+
+# ------------------------------------------------------ timing idioms
+
+
+def barrier(o):
+    """Fetch one element of one leaf — the only reliable completion
+    barrier on the tunnel backend (block_until_ready can return at
+    enqueue)."""
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    np.asarray(jnp.ravel(leaf)[0])
+
+
+def timed(name, fn, *args, reps=5):
+    """Simple block_until_ready timing: first call = compile, then the
+    median of ``reps`` runs.  Honest on CPU; on the tunnel it includes
+    one RTT per rep (use chain_time for RTT-free per-call costs)."""
+    t0 = time.time()
+    out = fn(*args)
+    barrier(out)
+    compile_s = time.time() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        out = fn(*args)
+        barrier(out)
+        times.append(time.time() - t0)
+    med = sorted(times)[len(times) // 2]
+    print(f"{name:44s} compile {compile_s:7.2f}s   run {med*1e3:9.2f} ms",
+          flush=True)
+    return out, med
+
+
+def chain_time(name, f, args, thread, k=8, settle=2):
+    """True per-call device cost by chaining: dispatch ``k`` calls with
+    a data dependency (``thread(out, args) -> next args``) and fetch
+    once; per-call ~= (t_k - t_1) / (k - 1) — the ~130 ms tunnel RTT
+    cancels."""
+    out = f(*args)
+    barrier(out)  # compile + settle
+
+    def run(n):
+        t0 = time.time()
+        a = args
+        o = f(*a)
+        for _ in range(n - 1):
+            a = thread(o, a)
+            o = f(*a)
+        barrier(o)
+        return time.time() - t0
+
+    t1 = min(run(1) for _ in range(settle))
+    tk = min(run(k) for _ in range(settle))
+    per = (tk - t1) / (k - 1)
+    print(f"{name:44s} 1x {t1*1e3:8.1f} ms   per-call {per*1e3:8.2f} ms",
+          flush=True)
+    return per
+
+
+def rng_cols(n, k, seed=0):
+    key = jax.random.PRNGKey(seed)
+    cols = []
+    for _ in range(k):
+        key, sub = jax.random.split(key)
+        cols.append(jax.random.bits(sub, (n,), jnp.uint32))
+    return cols
+
+
+# ------------------------------------------------------------- expand
+
+
+def cmd_expand(args):
+    """Per-stage cost of the round-1 expand pipeline (unpack ->
+    successors -> pack -> keys -> hashtable -> partition ->
+    invariants), with a visited table at a realistic load factor."""
+    from bench import scaled_config
+    from pulsar_tlaplus_tpu.engine.bfs import Checker
+    from pulsar_tlaplus_tpu.engine.core import partition_perm
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ops import dedup, hashtable
+
+    c = scaled_config()
+    model = CompactionModel(c)
+    layout = model.layout
+    F, A, W = args.chunk, model.A, layout.W
+    FA = F * A
+    cap = 1 << args.cap
+    print(f"device: {jax.devices()[0]}")
+    print(f"F={F} A={A} W={W} FA={FA} cap={cap} fill={args.fill}")
+
+    # realistic frontier: run BFS a few levels, take logged states
+    ck = Checker(model, frontier_chunk=4096, visited_cap=1 << 16,
+                 max_states=30_000, keep_log=True)
+    r = ck.run()
+    log_mat = ck.last_run_state.log.packed_matrix()
+    n_log = len(log_mat)
+    print(f"BFS seed run: {r.distinct_states} states, {r.diameter} levels")
+    frontier = jnp.asarray(log_mat[np.arange(FA) % n_log][:F])
+    nc = jnp.int32(F)
+
+    # visited table at a realistic load factor: random fill
+    rng = np.random.default_rng(0)
+    t1_, t2_, t3_, occ = hashtable.empty_table(cap)
+    ins = jax.jit(hashtable.lookup_insert)
+    fill_chunk = 1 << 19
+    for _start in range(0, args.fill, fill_chunk):
+        ks = [jnp.asarray(rng.integers(0, 2**32, fill_chunk, np.uint32))
+              for _ in range(3)]
+        _, t1_, t2_, t3_, occ, nf = ins(t1_, t2_, t3_, occ, *ks,
+                                        jnp.ones((fill_chunk,), bool))
+        assert int(nf) == 0
+    barrier(occ)
+    print(f"table load: {args.fill / cap:.2f}")
+
+    def stage_a(frontier, n):
+        f = frontier.shape[0]
+        row_live = jnp.arange(f, dtype=jnp.int32) < n
+        states = jax.vmap(layout.unpack)(frontier)
+        succ, valid = jax.vmap(model.successors)(states)
+        valid = valid & row_live[:, None]
+        packed = jax.vmap(jax.vmap(layout.pack))(succ)
+        return packed.reshape(f * A, W), valid.reshape(f * A)
+
+    fa = jax.jit(stage_a)
+    fb = jax.jit(lambda p: dedup.make_keys(p, layout.total_bits))
+
+    def stage_d(is_new, packed):
+        return packed[partition_perm(is_new)]
+
+    def stage_e(out_packed):
+        states = jax.vmap(layout.unpack)(out_packed)
+        oks = [jax.vmap(model.invariants[n])(states)
+               for n in model.default_invariants]
+        return jnp.stack([jnp.min(jnp.where(~ok, jnp.arange(FA), FA))
+                          for ok in oks]), out_packed
+
+    if args.mode == "timed":
+        (packed, valid), _ = timed("A unpack+successors+pack", fa,
+                                   frontier, nc)
+        (k1, k2, k3), _ = timed("B make_keys", fb, packed)
+        (is_new, *_rest), _ = timed(
+            "C hashtable lookup_insert", ins,
+            t1_, t2_, t3_, occ, k1, k2, k3, valid,
+        )
+        out_packed, _ = timed("D partition+gather", jax.jit(stage_d),
+                              is_new, packed)
+        timed("E invariants(all lanes)", jax.jit(stage_e), out_packed)
+
+        def stage_e2(frontier):
+            states = jax.vmap(layout.unpack)(frontier)
+            return jax.vmap(model.stutter_enabled)(states)
+
+        timed("E2 stutter check", jax.jit(stage_e2), frontier)
+        ck2 = Checker(model, frontier_chunk=F, visited_cap=cap)
+        step = ck2._get_step("expand")
+        out, med = timed("F full expand step", step, frontier, nc,
+                         t1_, t2_, t3_, occ, jnp.int32(args.fill))
+        n_new = int(out[3])
+        print(f"full step: n_new={n_new}, {FA/med:,.0f} lanes/s, "
+              f"{n_new/med:,.0f} new states/s")
+        return
+
+    # chained mode (RTT-free per-call costs)
+    chain_time("A unpack+succ+pack", fa, (frontier, nc),
+               lambda o, a: (o[0][:F] ^ jnp.uint32(0), a[1]))
+    packed, valid = fa(frontier, nc)
+    barrier(packed)
+    chain_time("B make_keys", fb, (packed,),
+               lambda o, a: (a[0] ^ (o[0][:, None] & jnp.uint32(0)),))
+    k1, k2, k3 = fb(packed)
+    barrier(k1)
+
+    def ins_thread(o, a):
+        return (o[1], o[2], o[3], o[4],
+                a[4] ^ (o[0][0].astype(jnp.uint32) & 0), a[5], a[6], a[7])
+
+    chain_time("C hashtable lookup_insert", ins,
+               (t1_, t2_, t3_, occ, k1, k2, k3, valid), ins_thread)
+    is_new = ins(t1_, t2_, t3_, occ, k1, k2, k3, valid)[0]
+    barrier(is_new)
+    chain_time("D partition+gather", jax.jit(stage_d), (is_new, packed),
+               lambda o, a: (a[0], o))
+    fe = jax.jit(stage_e)
+    chain_time("E invariants(all lanes)", fe, (packed,),
+               lambda o, a: (o[1] ^ (o[0][0].astype(jnp.uint32) & 0),))
+    step = Checker(model, frontier_chunk=F,
+                   visited_cap=cap)._get_step("expand")
+
+    def step_thread(o, a):
+        return (a[0] ^ (o[0][:F] & jnp.uint32(0)), a[1], o[4], o[5],
+                o[6], o[7], a[6])
+
+    chain_time("F full expand step", step,
+               (frontier, nc, t1_, t2_, t3_, occ, jnp.int32(args.fill)),
+               step_thread, k=6)
+
+
+# -------------------------------------------------------------- prims
+
+
+def _prims_v1():
+    rng = np.random.default_rng(0)
+    for n in (1 << 18, 1 << 21, 1 << 24):
+        cols = tuple(jnp.asarray(rng.integers(0, 2**32, n, np.uint32))
+                     for _ in range(4))
+        f = jax.jit(lambda a, b, c, d: lax.sort((a, b, c, d), num_keys=3))
+        chain_time(f"sort3+1payload n={n}", f, cols,
+                   lambda o, a: (o[0], o[1], o[2], o[3]), k=4)
+    for nq, cap in ((1 << 18, 1 << 23), (1 << 21, 1 << 23),
+                    (1 << 24, 1 << 25)):
+        tbl = jnp.asarray(rng.integers(0, 2**32, cap, np.uint32))
+        idx = jnp.asarray(rng.integers(0, cap, nq, np.int32))
+        f = jax.jit(lambda t, i: t[i])
+        chain_time(f"gather nq={nq} cap={cap}", f, (tbl, idx),
+                   lambda o, a: (a[0], (a[1] ^ (o & 0)).astype(jnp.int32)))
+    nq, nb = 1 << 18, 1 << 20
+    tbl = jnp.asarray(rng.integers(0, 2**32, (nb, 32), np.uint32))
+    idx = jnp.asarray(rng.integers(0, nb, nq, np.int32))
+    f = jax.jit(lambda t, i: t[i])
+    chain_time(f"gather-rows nq={nq} [1M,32]", f, (tbl, idx),
+               lambda o, a: (a[0],
+                             (a[1] ^ (o[:, 0] & 0)).astype(jnp.int32)))
+    nq, cap = 1 << 18, 1 << 23
+    tbl = jnp.zeros((cap,), jnp.uint32)
+    dup_idx = jnp.asarray(rng.integers(0, cap, nq, np.int32))
+    uni_idx = jnp.asarray(
+        rng.choice(cap, nq, replace=False).astype(np.int32))
+    uni_sorted = jnp.sort(uni_idx)
+    vals = jnp.asarray(rng.integers(0, 2**32, nq, np.uint32))
+    f = jax.jit(lambda t, i, v: t.at[i].min(v))
+    chain_time("scatter-min dup idx", f, (tbl, dup_idx, vals),
+               lambda o, a: (o, a[1], a[2]))
+    f = jax.jit(lambda t, i, v: t.at[i].set(v, unique_indices=True))
+    chain_time("scatter-set unique", f, (tbl, uni_idx, vals),
+               lambda o, a: (o, a[1], a[2]))
+    f = jax.jit(lambda t, i, v: t.at[i].set(
+        v, unique_indices=True, indices_are_sorted=True))
+    chain_time("scatter-set unique+sorted", f, (tbl, uni_sorted, vals),
+               lambda o, a: (o, a[1], a[2]))
+    f = jax.jit(lambda t, i, v: t.at[i].set(v))
+    chain_time("scatter-set dup-possible", f, (tbl, dup_idx, vals),
+               lambda o, a: (o, a[1], a[2]))
+    nq, cap = 1 << 21, 1 << 24
+    vis = jnp.sort(jnp.asarray(rng.integers(0, 2**32, cap, np.uint32)))
+    q = jnp.asarray(rng.integers(0, 2**32, nq, np.uint32))
+    f = jax.jit(lambda v, q: jnp.searchsorted(v, q))
+    chain_time(f"searchsorted nq={nq} cap={cap}", f, (vis, q),
+               lambda o, a: (a[0], a[1] ^ (o.astype(jnp.uint32) & 0)))
+
+
+def _prims_sorts():
+    n = 1 << 23  # 8.4M ~ accumulator width
+    for ops, stable in [(2, False), (3, False), (6, False), (11, False),
+                        (21, False), (21, True), (22, True)]:
+        cols = rng_cols(n, ops)
+        jf = jax.jit(
+            lambda *cs, _s=stable: lax.sort(cs, num_keys=1, is_stable=_s)
+        )
+        timed(f"sort n=2^23 ops={ops} stable={int(stable)}", jf, *cols)
+
+
+def _prims_big():
+    for logn in (25, 26):
+        n = 1 << logn
+        for ops, nk in [(3, 3), (3, 1), (4, 4)]:
+            cols = rng_cols(n, ops)
+            jf = jax.jit(
+                lambda *cs, _k=nk: lax.sort(cs, num_keys=_k,
+                                            is_stable=False)
+            )
+            timed(f"sort n=2^{logn} ops={ops} keys={nk}", jf, *cols)
+
+
+def _prims_gather():
+    t = 1 << 27
+    n = 1 << 23
+    tab = jax.random.bits(jax.random.PRNGKey(1), (t,), jnp.uint32)
+    idx = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, t, jnp.int32)
+    sidx = jnp.sort(idx)
+    g = jax.jit(lambda tb, ix: tb[ix])
+    timed("gather 2^23 random from 2^27", g, tab, idx)
+    timed("gather 2^23 sorted-idx from 2^27", g, tab, sidx)
+    sc = jax.jit(
+        lambda tb, ix, v: tb.at[ix].set(v, mode="drop",
+                                        unique_indices=True)
+    )
+    vals = jax.random.bits(jax.random.PRNGKey(3), (n,), jnp.uint32)
+    timed("scatter 2^23 random into 2^27", sc, tab, idx, vals)
+    timed("scatter 2^23 sorted into 2^27", sc, tab, sidx, vals)
+    tab2 = jax.random.bits(jax.random.PRNGKey(4), (2, t), jnp.uint32)
+    g2 = jax.jit(lambda tb, ix: (tb[0, ix], tb[1, ix]))
+    timed("gather 2x 2^23 random from 2^27", g2, tab2, idx)
+
+
+def cmd_prims(args):
+    print(f"device: {jax.devices()[0]}", flush=True)
+    cases = {"v1": _prims_v1, "sorts": _prims_sorts, "big": _prims_big,
+             "gather": _prims_gather}
+    for name, fn in cases.items():
+        if args.set in ("all", name):
+            fn()
+
+
+# ------------------------------------------------------------- stages
+
+
+def cmd_stages(args):
+    """Per-dispatch stage costs of the CURRENT device engine at bench
+    shapes: expand / flush (fpset probe) / compact / append as the
+    stage chain dispatches them, plus ONE fused level megakernel
+    dispatch over the same frontier — the r13 before/after in a single
+    probe.  ``--run S`` instead runs a budgeted bench-shape check under
+    PTT_STAGE_TIMING and prints the per-stage totals (the old
+    profile_stages5 mode)."""
+    from pulsar_tlaplus_tpu.engine.device_bfs import BIG, DeviceChecker
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.ops import fpset
+    from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+    from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+    if args.run is not None:
+        os.environ["PTT_STAGE_TIMING"] = "1"
+        from bench import BENCH_CHECKER_KW, scaled_config
+
+        c = scaled_config()
+        model = CompactionModel(c)
+        ck = DeviceChecker(model, time_budget_s=args.run, progress=True,
+                           fuse=args.fuse, **BENCH_CHECKER_KW)
+        t0 = time.time()
+        w = ck.warmup(seed=True)
+        print(f"warmup: {w:.1f}s  {ck.last_stats}", file=sys.stderr)
+        seed = model.host_seed(max_level_states=800_000,
+                               max_total=1_000_000)
+        print(f"seed: {len(seed[0])} states", file=sys.stderr)
+        r = ck.run(seed=seed)
+        print(f"run: {r.distinct_states} states / {r.diameter} levels "
+              f"in {r.wall_s:.1f}s ({r.states_per_sec:.0f} st/s) "
+              f"truncated={r.truncated}")
+        stages = {k: v for k, v in ck.last_stats.items()
+                  if k.startswith("stage_")}
+        print(f"stage totals: {stages}")
+        rtt = ck.last_stats.get("rtt_s", 0.13)
+        for name in ("fused", "expand", "flush", "compact", "append"):
+            s = stages.get(f"stage_{name}_s")
+            n = stages.get(f"stage_{name}_n")
+            if s is not None and n:
+                print(f"  {name}: {s:.1f}s / {n} dispatches "
+                      f"(~{s - rtt * n:.1f}s est device time)")
+        print(f"dispatches/level: "
+              f"{ck.last_stats.get('dispatches_per_level')}")
+        print(f"total: {time.time() - t0:.1f}s")
+        return
+
+    c = Constants(
+        message_sent_limit=64, compaction_times_limit=3, num_keys=8,
+        num_values=2, retain_null_key=True, max_crash_times=3,
+        model_producer=True, model_consumer=False,
+    )
+    model = CompactionModel(c)
+    ck = DeviceChecker(
+        model,
+        sub_batch=1 << args.sub_batch_log2,
+        expand_chunk=min(1 << 13, 1 << args.sub_batch_log2),
+        visited_cap=1 << 25,
+        frontier_cap=24_000_000
+        + (1 << args.sub_batch_log2) * model.A * args.flush_factor,
+        max_states=24_000_000,
+        flush_factor=args.flush_factor,
+        fuse="stage",  # the per-stage jits are what this probe times
+    )
+    print(f"device {jax.devices()[0]}; G={ck.G} A={ck.A} NCs={ck.NCs} "
+          f"ACAP={ck.ACAP} APAD={ck.APAD} K={ck.K} TCAP={ck.TCAP} "
+          f"LCAP={ck.LCAP} W={ck.W} SL={ck.SLc} C={ck.C}", flush=True)
+    t0 = time.time()
+    warm_s = ck.warmup(tiers=False)
+    print(f"warmup compile: {warm_s:.1f}s (wall {time.time()-t0:.1f}s)",
+          flush=True)
+
+    K = ck.K
+    z = jnp.zeros
+    ak = tuple(jnp.full((ck.ACAP,), SENTINEL, jnp.uint32)
+               for _ in range(K))
+    arows = z((ck.W, ck.ACAP), jnp.uint32)
+    rows_store = z((ck._rows_len(),), jnp.uint32)
+    vk = fpset.empty_cols(ck.TCAP, K)
+    fpm = z((fpset.FPM_N,), jnp.int32)
+    n_inv = len(ck.invariant_names)
+    viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
+
+    def bench(name, dispatch, iters=6):
+        t0 = time.time()
+        last = None
+        for _ in range(iters):
+            last = dispatch()
+        barrier(last)
+        dt = (time.time() - t0) / iters
+        print(f"{name:44s} {dt*1e3:9.1f} ms", flush=True)
+        return dt
+
+    # real initial states at rows 0..G
+    window = jax.jit(
+        jax.vmap(lambda i: model.layout.pack(model.gen_initial(i)))
+    )(jnp.arange(ck.G, dtype=jnp.int32) % model.n_initial).reshape(
+        ck.G * ck.W
+    )
+    barrier(window)
+
+    def do_expand():
+        nonlocal ak, arows
+        out = ck._expand_jit()(
+            *ak, arows, window, jnp.int32(0), jnp.int32(ck.G), BIG,
+            jnp.int32(0), jnp.int32(0),
+        )
+        ak, arows = out[:K], out[K]
+        return out[K + 1]
+
+    t_expand = bench("expand window (G states)", do_expand)
+
+    def do_flush():
+        nonlocal vk, fpm
+        out = ck._fpflush_jit()(*vk, *ak, jnp.int32(ck.ACAP), fpm)
+        vk, fpm = out[:K], out[K + 2]
+        return out[K]
+
+    t_flush = bench("flush (fpset probe-or-insert)", do_flush)
+
+    out = ck._fpflush_jit()(*vk, *ak, jnp.int32(ck.ACAP), fpm)
+    vk, n_new, flag, fpm = out[:K], out[K], out[K + 1], out[K + 2]
+    barrier(n_new)
+    print(f"  (n_new in flush probe: {int(np.asarray(n_new))})",
+          flush=True)
+
+    def do_compact():
+        nonlocal arows
+        crows, idx = ck._compact_jit()(arows, flag)
+        arows = crows
+        return idx
+
+    t_compact = bench("compact (log-shift stream)", do_compact)
+    crows, idx = ck._compact_jit()(arows, flag)
+    arows = crows
+    barrier(idx)
+
+    par_log = z((ck.PCAP,), jnp.int32)
+    lane_log = z((ck.PCAP,), jnp.int32)
+
+    def do_append():
+        nonlocal rows_store, par_log, lane_log
+        rows_store, par_log, lane_log, nv2, _v = ck._append_jit()(
+            rows_store, par_log, lane_log, crows, idx, n_new,
+            jnp.int32(0), viol0, jnp.int32(0), jnp.bool_(False),
+            jnp.int32(0), jnp.bool_(True),
+        )
+        return nv2
+
+    t_append = bench("append (invariants+DUS)", do_append)
+
+    per_flush = (t_expand * args.flush_factor + t_flush + t_compact
+                 + t_append)
+    print(f"total per flush-group (stage chain): {per_flush*1e3:.1f} ms "
+          f"for {ck.ACAP} candidate lanes", flush=True)
+    print(f"  -> ceiling at 100%/30%/10% new-rate: "
+          f"{ck.ACAP/per_flush/1e6:.2f} / "
+          f"{0.3*ck.ACAP/per_flush/1e6:.2f} / "
+          f"{0.1*ck.ACAP/per_flush/1e6:.2f} M st/s", flush=True)
+
+    # r13 comparison point: the same work as ONE fused megakernel
+    # dispatch (expand+flush+compact+append, zero intermediate
+    # dispatch boundaries) over a G-state frontier at row 0
+    ck2 = DeviceChecker(
+        model,
+        sub_batch=1 << args.sub_batch_log2,
+        expand_chunk=min(1 << 13, 1 << args.sub_batch_log2),
+        visited_cap=1 << 25,
+        frontier_cap=24_000_000
+        + (1 << args.sub_batch_log2) * model.A * args.flush_factor,
+        max_states=24_000_000,
+        flush_factor=args.flush_factor,
+        fuse="level",
+    )
+    fstate = {
+        "vk": fpset.empty_cols(ck2.TCAP, K),
+        "ak": tuple(jnp.full((ck2.ACAP,), SENTINEL, jnp.uint32)
+                    for _ in range(K)),
+        "arows": z((ck2.W, ck2.ACAP), jnp.uint32),
+        "rows": z((ck2._rows_len(),), jnp.uint32),
+        "parent": z((ck2.PCAP,), jnp.int32),
+        "lane": z((ck2.PCAP,), jnp.int32),
+        "nv": jnp.int32(0),
+        "fpm": z((fpset.FPM_N,), jnp.int32),
+    }
+
+    def do_fused():
+        out = ck2._fused_jit()(
+            *fstate["vk"], *fstate["ak"], fstate["arows"],
+            fstate["rows"], fstate["parent"], fstate["lane"],
+            fstate["nv"], BIG, viol0, fstate["fpm"],
+            jnp.int32(0), jnp.int32(ck2.G), jnp.int32(0),
+            jnp.int32(1), jnp.int32(1),
+            jnp.int32(0), jnp.bool_(True),
+        )
+        fstate["vk"] = out[:K]
+        fstate["ak"] = out[K: 2 * K]
+        (fstate["arows"], fstate["rows"], fstate["parent"],
+         fstate["lane"]) = out[2 * K: 2 * K + 4]
+        fstate["fpm"] = out[2 * K + 7]
+        return out[2 * K + 8]
+
+    barrier(do_fused())  # compile outside the timed iterations
+    bench("FUSED level megakernel (1 group)", do_fused, iters=4)
+
+
+# ---------------------------------------------------------------- lsm
+
+
+def cmd_lsm(args):
+    W = 20
+    N_ACC = 1 << 25
+    T = N_ACC + (1 << 25)
+    LIVE_FRAC = 0.03
+    print(f"device: {jax.devices()[0]}", flush=True)
+    key = jax.random.PRNGKey(0)
+    which = args.section
+
+    def bench(name, fn, a, k=8):
+        t0 = time.time()
+        out = fn(*a)
+        barrier(out)
+        compile_s = time.time() - t0
+        t0 = time.time()
+        outs = [fn(*a) for _ in range(k)]
+        barrier(outs[-1])
+        dt = (time.time() - t0) / k
+        print(f"{name:44s} {dt*1e3:9.1f} ms/iter   "
+              f"(compile {compile_s:.1f}s)", flush=True)
+        return dt
+
+    rows = jax.random.randint(
+        key, (N_ACC, W), 0, 1 << 30, dtype=jnp.int32
+    ).astype(jnp.uint32)
+    n_new = int(N_ACC * LIVE_FRAC)
+    idx_host = np.zeros((N_ACC,), np.int32)
+    idx_host[:n_new] = np.random.permutation(N_ACC)[:n_new]
+    gidx = jnp.asarray(idx_host)
+    sidx_host = np.full((N_ACC,), N_ACC + 5, np.int64)
+    sidx_host[:n_new] = np.arange(n_new)
+    sidx = jnp.asarray(sidx_host, jnp.int32)
+    store = jnp.zeros((N_ACC + 8, W), jnp.uint32)
+
+    if which == "sort":
+        k1 = jax.random.bits(key, (T,), jnp.uint32)
+        k2 = jax.random.bits(jax.random.PRNGKey(1), (T,), jnp.uint32)
+        pay = jax.random.bits(jax.random.PRNGKey(3), (T,), jnp.uint32)
+        del rows, store
+        s3 = jax.jit(lambda a, b, c: lax.sort((a, b, c), num_keys=3,
+                                              is_stable=False))
+        bench(f"sort 3-operand T={T>>20}M", s3, (k1, k2, pay))
+        s2 = jax.jit(lambda a, b: lax.sort((a, b), num_keys=1,
+                                           is_stable=True))
+        bench(f"sort 2-operand stable T={T>>20}M", s2, (k1, pay))
+        nn = N_ACC
+        s3n = jax.jit(lambda a, b, c: lax.sort(
+            (a[:nn], b[:nn], c[:nn]), num_keys=3, is_stable=False))
+        bench(f"sort 3-operand T={nn>>20}M", s3n, (k1, k2, pay))
+    elif which == "sort4":
+        t2 = (1 << 25) + (1 << 23)
+        del rows, store
+        ks = [jax.random.bits(jax.random.PRNGKey(i), (t2,), jnp.uint32)
+              for i in range(4)]
+        s4 = jax.jit(lambda a, b, c, d: lax.sort(
+            (a, b, c, d), num_keys=4, is_stable=False))
+        bench(f"sort 4-operand T={t2>>20}M (r2 shape)", s4, tuple(ks))
+    elif which == "gather":
+        g = jax.jit(lambda r, i: r[i])
+        bench("gather 33.5M rows[20] (3% random live)", g, (rows, gidx))
+        ridx = jnp.asarray(np.random.permutation(N_ACC).astype(np.int32))
+        bench("gather 33.5M rows[20] (100% random)", g, (rows, ridx))
+    elif which == "scatter":
+        sc = jax.jit(
+            lambda st, r, i: st.at[i].set(r, mode="drop",
+                                          unique_indices=True,
+                                          indices_are_sorted=True))
+        bench("scatter 33.5M rows[20] contig (3% live)", sc,
+              (store, rows, sidx))
+        sidx_all = jnp.arange(N_ACC, dtype=jnp.int32)
+        bench("scatter 33.5M rows[20] contig (all live)", sc,
+              (store, rows, sidx_all))
+        d = jax.jit(lambda st, r: lax.dynamic_update_slice(st, r, (5, 0)))
+        bench("DUS 33.5M rows[20] window", d, (store, rows))
+        st1 = jnp.zeros((N_ACC + 8,), jnp.uint32)
+        sc1 = jax.jit(
+            lambda st, v, i: st.at[i].set(v, mode="drop",
+                                          unique_indices=True,
+                                          indices_are_sorted=True))
+        bench("scatter 33.5M u32 contig (3% live)", sc1,
+              (st1, jax.random.bits(key, (N_ACC,), jnp.uint32), sidx))
+
+
+# ------------------------------------------------------------- bucket
+
+
+def cmd_bucket(_args):
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}")
+    ROW = 32
+    for nq, nb in ((1 << 20, 1 << 21), (1 << 23, 1 << 22)):
+        flat = jnp.asarray(rng.integers(0, 2**32, nb * ROW, np.uint32))
+        idx = jnp.asarray(rng.integers(0, nb, nq, np.int32))
+
+        def rowgather(flat, idx):
+            g = jax.vmap(
+                lambda i: lax.dynamic_slice(flat, (i * ROW,), (ROW,)))
+            return g(idx)
+
+        chain_time(f"flat-row-gather nq={nq} nb={nb} row{ROW}",
+                   jax.jit(rowgather), (flat, idx),
+                   lambda o, a: (a[0],
+                                 (a[1] ^ (o[:, 0] & 0)).astype(jnp.int32)))
+        tbl2d = flat.reshape(nb, ROW)
+        chain_time(f"2d-row-gather   nq={nq} nb={nb} row{ROW}",
+                   jax.jit(lambda t, i: t[i]), (tbl2d, idx),
+                   lambda o, a: (a[0],
+                                 (a[1] ^ (o[:, 0] & 0)).astype(jnp.int32)))
+    nq, cap = 1 << 22, 1 << 27
+    tbl = jnp.zeros((cap,), jnp.uint32)
+    uni = jnp.asarray(
+        (rng.permutation(cap >> 5)[:nq].astype(np.int64) << 5)
+        .astype(np.int32))
+    vals = jnp.asarray(rng.integers(0, 2**32, nq, np.uint32))
+    chain_time("scatter-set unique 4M into 128M",
+               jax.jit(lambda t, i, v: t.at[i].set(
+                   v, unique_indices=True)),
+               (tbl, uni, vals), lambda o, a: (o, a[1], a[2]))
+    n = 8_700_000
+    cols = tuple(jnp.asarray(rng.integers(0, 2**32, n, np.uint32))
+                 for _ in range(5))
+    chain_time("sort4+1 n=8.7M",
+               jax.jit(lambda *c: lax.sort(c, num_keys=4)), cols,
+               lambda o, a: tuple(o), k=4)
+    starts = jnp.asarray(rng.integers(0, 2, n, np.int32))
+
+    def segrank(starts):
+        i = jnp.arange(n, dtype=jnp.int32)
+        run_start = jnp.where(starts == 1, i, 0)
+        seg = lax.cummax(run_start)
+        return i - seg
+
+    chain_time("segmented-rank cummax 8.7M", jax.jit(segrank), (starts,),
+               lambda o, a: ((a[0] ^ (o & 0)).astype(jnp.int32),), k=4)
+
+
+# --------------------------------------------------------------- main
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="real-chip profiling probes (see module docstring "
+        "for the retired-script mapping)"
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    pe = sub.add_parser("expand", help="expand-pipeline stage breakdown")
+    pe.add_argument("--mode", choices=["timed", "chained"],
+                    default="chained")
+    pe.add_argument("--chunk", type=int, default=8192)
+    pe.add_argument("--cap", type=int, default=23, help="log2 visited cap")
+    pe.add_argument("--fill", type=int, default=3_000_000,
+                    help="pre-inserted random keys (sets load factor)")
+    pe.set_defaults(fn=cmd_expand)
+
+    pp = sub.add_parser("prims", help="primitive cost curves")
+    pp.add_argument("--set", choices=["v1", "sorts", "big", "gather",
+                                      "all"], default="all")
+    pp.set_defaults(fn=cmd_prims)
+
+    ps = sub.add_parser(
+        "stages", help="device-engine per-dispatch stage costs "
+        "(+ fused megakernel comparison)")
+    ps.add_argument("--sub-batch-log2", type=int, default=19)
+    ps.add_argument("--flush-factor", type=int, default=1)
+    ps.add_argument("--run", type=float, default=None, metavar="S",
+                    help="instead: budgeted bench-shape run under "
+                    "PTT_STAGE_TIMING (old profile_stages5)")
+    ps.add_argument("--fuse", choices=["level", "stage"],
+                    default="level", help="fusion mode for --run")
+    ps.set_defaults(fn=cmd_stages)
+
+    pl = sub.add_parser("lsm", help="round-3 LSM primitive shapes")
+    pl.add_argument("--section", choices=["sort", "sort4", "gather",
+                                          "scatter"], default="sort",
+                    help="one section per process (incompatible "
+                    "buffer sets)")
+    pl.set_defaults(fn=cmd_lsm)
+
+    pb = sub.add_parser("bucket", help="bucketized-hash primitives")
+    pb.set_defaults(fn=cmd_bucket)
+
+    args = ap.parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,9 @@
 """Shared helpers for differential tests: oracle BFS sampling and
 counterexample-trace validation."""
 
+import functools
 import random
+import subprocess
 
 import jax
 import pytest
@@ -22,6 +24,46 @@ needs_shard_map = pytest.mark.skipif(
     not hasattr(jax, "shard_map"),
     reason="sharded engines need jax.shard_map (newer jax; container "
     "jax 0.4.37 lacks it)",
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _native_baseline_runnable() -> bool:
+    """True when the COMMITTED native baseline binary actually RUNS
+    here.  The binary was built on the real host; a container with an
+    older glibc loads it and dies before main — probe with a tiny
+    config instead of pattern-matching on toolchain presence.  Probes
+    the tracked binary path directly, never ``build_baseline()``: a
+    rebuild would overwrite the tracked binary AND mask the very
+    environment difference the skip exists to report."""
+    try:
+        import os
+
+        from pulsar_tlaplus_tpu import native
+
+        binary = os.path.join(
+            os.path.dirname(native.__file__), "compaction_bfs"
+        )
+        if not os.path.exists(binary):
+            return False
+        p = subprocess.run(
+            [binary, "1", "1", "1", "1", "0", "0", "1", "5", "1", "10"],
+            capture_output=True, text=True, timeout=60,
+        )
+        return p.returncode in (0, 1) and bool(p.stdout.strip())
+    except Exception:  # noqa: BLE001 — any failure mode means "skip"
+        return False
+
+
+# The native TLC-class baseline (BASELINE.md) needs a binary the
+# current libc can actually load.  Same regime as needs_shard_map: a
+# clean container run reports SKIPs, not failures; the real host (and
+# any glibc >= the build host's) still runs the tests.
+needs_native_binary = pytest.mark.skipif(
+    not _native_baseline_runnable(),
+    reason="native baseline binary is not runnable in this "
+    "environment (glibc/toolchain mismatch; runnable on the real "
+    "host)",
 )
 
 

@@ -211,7 +211,11 @@ def test_trace_roundtrip_solo_run(solo_stream, tmp_path):
         if e.get("ph") == "X"
         and str(e.get("name", "")).startswith("level ")
     ]
-    assert len(levels) == r.diameter + 1  # one span per level record
+    # one span per level record (r13: the fused engine emits exactly
+    # one boundary record per level past the init level — no
+    # intra-level fetch records on this no-growth shape)
+    n_level_records = sum(1 for e in events if e["event"] == "level")
+    assert len(levels) == n_level_records == r.diameter - 1
     ends = 0.0
     for e in sorted(levels, key=lambda e: e["ts"]):
         assert e["dur"] >= 0

@@ -7,7 +7,12 @@ import pytest
 
 from pulsar_tlaplus_tpu import native
 from pulsar_tlaplus_tpu.ref import pyeval as pe
-from tests.helpers import SMALL_CONFIGS
+from tests.helpers import SMALL_CONFIGS, needs_native_binary
+
+# every test here shells out to the committed baseline binary; where
+# the environment cannot run it (container glibc older than the build
+# host's) the whole module SKIPS — same regime as needs_shard_map
+pytestmark = needs_native_binary
 
 
 def _run(c, budget_s=300.0):
