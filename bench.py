@@ -583,8 +583,12 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # — validated by scripts/check_telemetry_schema.py;
                 # schema 6 (r13) adds the level-fusion mode + the
                 # run's dispatch economy (dispatches_per_level,
-                # stage_fused_n, fuse_levels)
-                "bench_schema": 6,
+                # stage_fused_n, fuse_levels); schema 7 (r14) adds the
+                # in-kernel work-unit totals (work_*) the
+                # cost-attribution model prices and the ledger gates
+                # (work-units/state is the machine-independent
+                # efficiency signal)
+                "bench_schema": 7,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2
@@ -656,6 +660,16 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "dispatches_per_level": stat("dispatches_per_level"),
                 "stage_fused_n": stat("stage_fused_n"),
                 "fuse_levels": stat("fuse_levels"),
+                # in-kernel work-unit totals (r14, bench_schema 7):
+                # the cost-attribution inputs and the ledger's
+                # machine-independent efficiency signal
+                # (work-units/state) — docs/observability.md
+                # "Attribution"
+                "work_expand_rows": stat("work_expand_rows"),
+                "work_probe_lanes": stat("work_probe_lanes"),
+                "work_compact_elems": stat("work_compact_elems"),
+                "work_append_rows": stat("work_append_rows"),
+                "work_groups": stat("work_groups"),
                 # per-stage dispatch counts straight from the stream
                 # (the telemetry_report --bench-keys layer; None when
                 # --no-telemetry)

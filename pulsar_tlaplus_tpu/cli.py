@@ -17,6 +17,7 @@ engine/interp_check.py).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -877,6 +878,110 @@ def _cmd_top(args) -> int:
         return 0
 
 
+def _cmd_ledger(args) -> int:
+    """The cross-run regression ledger (obs/ledger.py,
+    docs/observability.md "Attribution"): ingest BENCH artifacts and
+    telemetry streams into an append-only JSONL ledger, render
+    trajectory tables and per-run deltas, and gate regressions."""
+    from pulsar_tlaplus_tpu.obs import ledger
+
+    path = args.ledger
+
+    def _rec_of(ref: str, recs):
+        # a REF that names an existing file ingests on the fly, so
+        # `ledger compare BENCH_r04.json BENCH_r05.json` works with no
+        # ledger file at all
+        if os.path.exists(ref):
+            return ledger.record_from_file(ref)
+        return ledger.resolve(recs, ref)
+
+    if args.ledger_cmd == "add":
+        recs = []
+        for p in args.files:
+            try:
+                recs.append(ledger.record_from_file(p))
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"tpu-tlc: {p}: {e}", file=sys.stderr)
+                return 2
+        added = ledger.append(path, recs)
+        print(
+            f"ingested {added} new record(s) of {len(recs)} into "
+            f"{path} ({len(ledger.load(path))} total)"
+        )
+        return 0
+    recs = ledger.load(path)
+    if args.ledger_cmd == "list":
+        print(ledger.render_list(recs, key=args.key))
+        return 0
+    try:
+        if args.ledger_cmd == "show":
+            print(ledger.render_show(_rec_of(args.ref, recs)))
+            return 0
+        if args.ledger_cmd == "compare":
+            a = _rec_of(args.ref_a, recs)
+            b = _rec_of(args.ref_b, recs)
+            print(ledger.render_compare(a, b))
+            return 0
+        if args.ledger_cmd == "gate":
+            if args.current:
+                cur = _rec_of(args.current, recs)
+            elif recs:
+                cur = recs[-1]
+            else:
+                print("tpu-tlc: empty ledger, nothing to gate",
+                      file=sys.stderr)
+                return 2
+            if args.baseline:
+                base = _rec_of(args.baseline, recs)
+            else:
+                # newest record PRECEDING the current one with the
+                # SAME config key — gating an older record must never
+                # pick a newer run as its baseline (that would invert
+                # the comparison)
+                cut = next(
+                    (
+                        i for i, r in enumerate(recs)
+                        if r.get("digest") == cur.get("digest")
+                    ),
+                    len(recs),
+                )
+                base = next(
+                    (
+                        r for r in reversed(recs[:cut])
+                        if r.get("key") == cur.get("key")
+                    ),
+                    None,
+                )
+                if base is None:
+                    print(
+                        "tpu-tlc: no baseline with a matching config "
+                        "key in the ledger (pass --baseline REF)",
+                        file=sys.stderr,
+                    )
+                    return 2
+            keys = tuple(args.keys) if args.keys else None
+            violations = ledger.gate(
+                base, cur, threshold=args.threshold, keys=keys
+            )
+            print(
+                f"baseline {base.get('source')} "
+                f"({base.get('digest', '?')[:8]}) vs current "
+                f"{cur.get('source')} ({cur.get('digest', '?')[:8]})"
+            )
+            print(ledger.render_gate(violations))
+            return 1 if violations else 0
+    except (
+        KeyError, OSError, ValueError, json.JSONDecodeError
+    ) as e:
+        # exit 2 (usage/input failure) — for `gate` especially, a
+        # malformed file must never surface as the interpreter's
+        # exit 1, which would read as "regression found"
+        msg = e.args[0] if isinstance(e, KeyError) else str(e)
+        print(f"tpu-tlc: {msg}", file=sys.stderr)
+        return 2
+    return 2
+
+
 def _cmd_cache(args) -> int:
     from pulsar_tlaplus_tpu.utils import aot_cache
 
@@ -1072,6 +1177,68 @@ def main(argv=None):
         help="render one frame (no ANSI clear) and exit",
     )
     _add_client_args(pt)
+
+    pl = sub.add_parser(
+        "ledger",
+        help="cross-run regression ledger: ingest BENCH_*.json "
+        "artifacts + telemetry streams into an append-only JSONL "
+        "ledger, render trajectories and deltas, gate regressions "
+        "(docs/observability.md)",
+    )
+    pl.add_argument(
+        "--ledger", default="LEDGER.jsonl", metavar="FILE",
+        help="ledger file (append-only JSONL; default ./LEDGER.jsonl)",
+    )
+    lsub = pl.add_subparsers(dest="ledger_cmd", required=True)
+    pla = lsub.add_parser(
+        "add", help="ingest artifacts/streams (idempotent by digest)"
+    )
+    pla.add_argument(
+        "files", nargs="+",
+        help="BENCH_*.json artifacts and/or telemetry .jsonl streams",
+    )
+    pll = lsub.add_parser(
+        "list", help="trajectory table of every ledger record"
+    )
+    pll.add_argument(
+        "--key", default=None,
+        help="only records with this config key",
+    )
+    pls = lsub.add_parser("show", help="every key of one record")
+    pls.add_argument(
+        "ref", help="digest prefix, source name, 1-based index, or a "
+        "file path (ingested on the fly)",
+    )
+    plc = lsub.add_parser(
+        "compare", help="per-key delta table between two runs"
+    )
+    plc.add_argument("ref_a", help="baseline record REF (or file path)")
+    plc.add_argument("ref_b", help="current record REF (or file path)")
+    plg = lsub.add_parser(
+        "gate",
+        help="exit 1 when the current run regresses past the "
+        "threshold vs its baseline (same config key by default)",
+    )
+    plg.add_argument(
+        "--current", default=None,
+        help="current record REF or file path (default: newest "
+        "ledger record)",
+    )
+    plg.add_argument(
+        "--baseline", default=None,
+        help="baseline record REF or file path (default: newest "
+        "earlier record with the same config key)",
+    )
+    plg.add_argument(
+        "--threshold", type=float, default=0.1, metavar="REL",
+        help="relative regression tolerance (default 0.10 = 10%%)",
+    )
+    plg.add_argument(
+        "--keys", nargs="*", default=None,
+        help="gated keys (default: every known gate key; "
+        "machine-independent choices: dispatches_per_level "
+        "work_units_per_state)",
+    )
 
     pch = sub.add_parser(
         "cache",
@@ -1300,6 +1467,7 @@ def main(argv=None):
             "watch": _cmd_watch,
             "cancel": _cmd_cancel,
             "cache": _cmd_cache,
+            "ledger": _cmd_ledger,
             "trace": _cmd_trace,
             "metrics": _cmd_metrics,
             "top": _cmd_top,

@@ -101,6 +101,16 @@ BIG = jnp.int32(2**31 - 1)
 # the sharded engine via ops/fpset.py (r9).
 FPM_N = fpset.FPM_N
 
+# In-kernel work-unit vector (round 14, fused-era cost attribution):
+# the level megakernel accumulates per-stage work units — live expand
+# rows, presented probe lanes (hi/lo), compacted elements (hi/lo),
+# appended rows, while-iterations — and returns them in the packed
+# stats vector, so per-stage cost attribution survives fusion with
+# zero extra syncs.  The stage chain counts the identical units
+# host-side at its dispatch sites (``_work_add``), so fused and stage
+# totals are equal state-for-state (pinned in tests).
+WKM_N = fpset.WKM_N
+
 # payload word: low 31 bits = accumulator slot index, bit 31 = the
 # candidate tag (visited entries carry payload 0, so the payload doubles
 # as the visited-vs-candidate sort tie-breaker)
@@ -465,6 +475,19 @@ class DeviceChecker:
             self.last_stats.get(f"stage_{name}_s", 0.0) + time.time() - t0
         )
         return out
+
+    def _work_add(self, **units):
+        """Accumulate per-run work units (r14, fused-era cost
+        attribution) into ``last_stats`` as ``work_<name>`` keys.  The
+        stage chain calls this host-side at its dispatch sites with
+        the SAME unit definitions the fused megakernel accumulates
+        in-kernel, so fused and stage totals agree exactly — free
+        host-side adds, zero device syncs."""
+        for k, v in units.items():
+            v = int(v)
+            if v:
+                key = f"work_{k}"
+                self.last_stats[key] = self.last_stats.get(key, 0) + v
 
     # -------------------------------------------------------- jitted ops
 
@@ -921,12 +944,18 @@ class DeviceChecker:
         buffer donated end-to-end.
 
         Operands: ``(vk, ak, arows, rows, parent, lane, n_visited,
-        dead_gid, viol, fpm, level_base, nf, w_off, levels_left,
+        dead_gid, viol, fpm, wkm, level_base, nf, w_off, levels_left,
         groups_left, row_base, rows_ok)``; returns the updated buffers
         + state scalars + one packed int32 stats vector ``[nv, dead,
-        viol..., fpm..., level_base, nf, w_off, n_lv, rows_ok,
+        viol..., fpm..., wkm..., level_base, nf, w_off, n_lv, rows_ok,
         groups_left, lsizes[RMAX]]`` so the host's ONE fetch reads
-        everything (no separate stats dispatch).
+        everything (no separate stats dispatch).  ``wkm`` is the
+        :data:`fpset.WKM_N` work-unit vector (round 14): every while
+        iteration accumulates the group's live expand rows, presented
+        probe lanes, compacted elements, appended rows, and the
+        iteration itself — per-stage work units the cost-attribution
+        layer converts into estimated seconds, riding the same fetch
+        with zero extra syncs.
 
         The loop runs while (a) the host-granted group/level budgets
         hold, (b) the next flush group's worst case fits the capacity
@@ -973,7 +1002,7 @@ class DeviceChecker:
             vk = args[:K]
             ak = args[K: 2 * K]
             (arows, rows, parent, lane, n_visited, dead, viol, fpm,
-             level_base, nf, w_off, levels_left, groups_left,
+             wkm, level_base, nf, w_off, levels_left, groups_left,
              row_base, rows_ok) = args[2 * K:]
 
             def viol_found(viol, dead):
@@ -981,8 +1010,8 @@ class DeviceChecker:
 
             def cond(st):
                 (vk, ak, arows, rows, parent, lane, nv, dead, viol,
-                 fpm, lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
-                 n_lv) = st
+                 fpm, wkm, lb, nf, w_off, lv_left, g_left, rows_ok,
+                 lsizes, n_lv) = st
                 live = nf - w_off  # frontier rows not yet expanded
                 gnew = jnp.where(
                     live > ACAP // A, jnp.int32(ACAP),
@@ -1009,8 +1038,8 @@ class DeviceChecker:
 
             def body(st):
                 (vk, ak, arows, rows, parent, lane, nv, dead, viol,
-                 fpm, lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
-                 n_lv) = st
+                 fpm, wkm, lb, nf, w_off, lv_left, g_left, rows_ok,
+                 lsizes, n_lv) = st
                 # expand FLUSH windows into the accumulator (windows
                 # past the frontier end produce SENTINEL lanes — the
                 # same masking the stage chain's partial fills rely on)
@@ -1043,6 +1072,18 @@ class DeviceChecker:
                     lb + w_off, jnp.bool_(False), row_base, rows_ok,
                 )
                 arows = crows  # recycled as the next group's buffer
+                # in-kernel work units (r14): the group's LIVE frontier
+                # rows (level totals then equal the stage chain's
+                # per-dispatch sums exactly), the full accumulator
+                # width presented to flush + compact (their dense cost
+                # driver), the deduped rows appended, and this
+                # iteration — all riding the stats vector below
+                wkm = fpset.wkm_update(
+                    wkm,
+                    jnp.clip(nf - w_off, 0, FLUSH * G),
+                    jnp.int32(ACAP), jnp.int32(ACAP),
+                    n_new, jnp.int32(1),
+                )
                 w_off2 = w_off + jnp.int32(FLUSH * G)
                 g_left = g_left - 1
                 # level boundary?
@@ -1061,22 +1102,22 @@ class DeviceChecker:
                 w_off = jnp.where(done, jnp.int32(0), w_off2)
                 return (
                     vk, ak, arows, rows, parent, lane, nv2, dead,
-                    viol, fpm, lb, nf, w_off, lv_left, g_left,
+                    viol, fpm, wkm, lb, nf, w_off, lv_left, g_left,
                     rows_ok, lsizes, n_lv,
                 )
 
             st = (
                 tuple(vk), tuple(ak), arows, rows, parent, lane,
-                n_visited, dead, viol, fpm, level_base, nf, w_off,
+                n_visited, dead, viol, fpm, wkm, level_base, nf, w_off,
                 levels_left, groups_left, rows_ok,
                 jnp.zeros((RMAX,), jnp.int32), jnp.int32(0),
             )
             (vk, ak, arows, rows, parent, lane, nv, dead, viol, fpm,
-             lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
+             wkm, lb, nf, w_off, lv_left, g_left, rows_ok, lsizes,
              n_lv) = lax.while_loop(cond, body, st)
             statsvec = jnp.concatenate(
                 [
-                    jnp.stack([nv, dead]), viol, fpm,
+                    jnp.stack([nv, dead]), viol, fpm, wkm,
                     jnp.stack(
                         [
                             lb, nf, w_off, n_lv,
@@ -1088,7 +1129,7 @@ class DeviceChecker:
             )
             return (
                 *vk, *ak, arows, rows, parent, lane, nv, dead, viol,
-                fpm, statsvec,
+                fpm, wkm, statsvec,
             )
 
         fn = ajit(step, donate_argnums=tuple(range(2 * K + 4)))
@@ -1487,6 +1528,9 @@ class DeviceChecker:
                 for col in vks
             )
         st["n_visited"] = jnp.int32(n)
+        # seed states land via seed_write, not the append body: they
+        # are not append work (the post-seed fetch must not count them)
+        self._work_nv_prev = int(n)
         return [int(x) for x in lsizes]
 
     # ------------------------------------------------------------ growth
@@ -1801,6 +1845,7 @@ class DeviceChecker:
             z((self.PCAP,), jnp.int32),
             z((self.PCAP,), jnp.int32),
             jnp.int32(0), BIG, viol0, z((FPM_N,), jnp.int32),
+            z((WKM_N,), jnp.int32),
             jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
             jnp.int32(0), jnp.int32(0), jnp.bool_(True),
         )
@@ -1997,6 +2042,15 @@ class DeviceChecker:
         self._ckpt_retries = 0
         self._fetch_n = 0
         self._fpm_prev = np.zeros((fpset.FPM_LOGICAL_N,), np.int64)
+        # work-unit state (r14): the ``work_*`` counters are PER-RUN
+        # (cost attribution prices THIS run; a pooled checker's next
+        # job must not inherit the last job's work), so clear them and
+        # rebaseline the device-vector / nv-delta trackers
+        for k in [k for k in self.last_stats if k.startswith("work_")]:
+            del self.last_stats[k]
+        self._wkm_prev = np.zeros((fpset.WKM_LOGICAL_N,), np.int64)
+        self._last_wkm_delta: Dict[str, int] = {}
+        self._work_nv_prev = 0
         # compact-event deltas baseline at THIS run's starting counter
         # values: the stage counters in last_stats are lifetime
         # cumulative, and a second run() on the same checker must not
@@ -2206,6 +2260,10 @@ class DeviceChecker:
             # device-accumulated fpset metrics [flushes, probe rounds,
             # failures] — ride the regular stats fetch
             st["fpm"] = jnp.zeros((FPM_N,), jnp.int32)
+        if self.fuse == "level":
+            # device-accumulated work units (r14) — ride the fused
+            # kernel's packed stats vector, zero extra syncs
+            st["wkm"] = jnp.zeros((WKM_N,), jnp.int32)
 
         # frontier-window state: gid of rows[0], and whether row writes
         # are still landing in the window (False = diverted to scratch;
@@ -2251,6 +2309,11 @@ class DeviceChecker:
             w = 0
             group_base = 0
             for f_off in range(0, n_init, self.NCs):
+                # init work (r14): live initial-state lanes generated
+                # (host-dispatched in BOTH fuse modes, so parity holds)
+                self._work_add(
+                    init_lanes=min(self.NCs, n_init - f_off)
+                )
                 out = self._init_jit()(
                     *bufs["ak"], bufs["arows"], jnp.int32(f_off),
                     jnp.int32(w * self.NCs),
@@ -2304,6 +2367,33 @@ class DeviceChecker:
         self._fetch_n += 1
         nv = int(out[0])
         self._snap["distinct_states"] = nv
+        # work-unit accounting (r14): a fused stats vector carries the
+        # in-kernel work counters — fold their deltas into the per-run
+        # ``work_*`` totals; whatever part of the nv delta the kernel
+        # did NOT append was appended by stage-chain dispatches (the
+        # init path, stage mode), so appends are never double-counted
+        # and never missed.  Free host arithmetic on an already-fetched
+        # vector — zero extra syncs.
+        k_append = 0
+        if vec is not None and self.fuse == "level":
+            n_inv = len(self.invariant_names)
+            wkm = out[2 + n_inv + FPM_N: 2 + n_inv + FPM_N + WKM_N]
+            wl = fpset.wkm_logical(wkm)
+            dw = wl - self._wkm_prev
+            self._wkm_prev = wl
+            self._last_wkm_delta = {
+                "expand_rows": int(dw[0]),
+                "probe_lanes": int(dw[1]),
+                "compact_elems": int(dw[2]),
+                "append_rows": int(dw[3]),
+                "groups": int(dw[4]),
+            }
+            self._work_add(**self._last_wkm_delta)
+            k_append = int(dw[3])
+        stage_append = nv - getattr(self, "_work_nv_prev", nv) - k_append
+        if stage_append > 0:
+            self._work_add(append_rows=stage_append)
+        self._work_nv_prev = nv
         if fpmode:
             n_inv = len(self.invariant_names)
             self._last_fpm = out[2 + n_inv: 2 + n_inv + FPM_N]
@@ -2384,6 +2474,14 @@ class DeviceChecker:
         contract either way."""
         K = self.K
         fpmode = self.visited_impl == "fpset"
+        # host-side work units (r14), mirroring the fused kernel's
+        # in-kernel definitions exactly: the full accumulator width is
+        # what the flush probes and the compaction moves (dense cost is
+        # width-bound, not valid-lane-bound), and each call is one
+        # flush group
+        self._work_add(
+            probe_lanes=self.ACAP, compact_elems=self.ACAP, groups=1
+        )
         self._flush_seq += 1
         kinds = faults.poll("flush", self._flush_seq)
         if "oom" in kinds:
@@ -2663,6 +2761,9 @@ class DeviceChecker:
                     )
                 for f_off in range(0, nf, self.G):
                     last = f_off + self.G >= nf
+                    # live rows this window expands (the fused kernel
+                    # counts the identical clip in-kernel)
+                    self._work_add(expand_rows=min(self.G, nf - f_off))
                     out = self._stage_mark(
                         "expand",
                         self._expand_jit()(
@@ -2918,7 +3019,7 @@ class DeviceChecker:
                         *bufs["vk"], *bufs["ak"], bufs["arows"],
                         bufs["rows"], bufs["parent"], bufs["lane"],
                         st["n_visited"], st["dead_gid"], st["viol"],
-                        st["fpm"], jnp.int32(level_base),
+                        st["fpm"], st["wkm"], jnp.int32(level_base),
                         jnp.int32(nf), jnp.int32(w_off),
                         jnp.int32(lv_cap),
                         jnp.int32(self._groups_cap()),
@@ -2931,13 +3032,13 @@ class DeviceChecker:
                 (
                     bufs["arows"], bufs["rows"], bufs["parent"],
                     bufs["lane"], st["n_visited"], st["dead_gid"],
-                    st["viol"], st["fpm"],
-                ) = out[2 * K: 2 * K + 8]
+                    st["viol"], st["fpm"], st["wkm"],
+                ) = out[2 * K: 2 * K + 9]
                 # the kernel's packed stats vector IS the fetch — a
                 # fused level pays 1 dispatch + 1 fetch, nothing else
-                stats = self._fetch(st, vec=out[2 * K + 8])
+                stats = self._fetch(st, vec=out[2 * K + 9])
                 nv = int(stats[0])
-                tail = stats[2 + n_inv + FPM_N:]
+                tail = stats[2 + n_inv + FPM_N + WKM_N:]
                 lb2, nf2, w_off2, n_lv, rows_ok_i = (
                     int(x) for x in tail[:5]
                 )
@@ -2950,6 +3051,7 @@ class DeviceChecker:
                 if self.rows_window == "frontier":
                     rb["rows_ok"] = bool(rows_ok_i)
                 self._replay_flush_faults(st, fl_before)
+                wd = self._last_wkm_delta
                 self.tel.emit(
                     "fuse",
                     levels=n_lv,
@@ -2957,6 +3059,13 @@ class DeviceChecker:
                     flushes=int(fpset.fpm_logical(self._last_fpm)[0])
                     - fl_before,
                     frontier=int(nf),
+                    # per-dispatch work-unit deltas (v7): the in-kernel
+                    # counters this dispatch accumulated — the stream-
+                    # level attribution signal
+                    work_expand_rows=int(wd.get("expand_rows", 0)),
+                    work_probe_lanes=int(wd.get("probe_lanes", 0)),
+                    work_compact_elems=int(wd.get("compact_elems", 0)),
+                    work_append_rows=int(wd.get("append_rows", 0)),
                 )
                 # ---- per-level accounting replay (the kernel's
                 # lsizes): level records, log lines, and PTT_FAULT
@@ -3306,6 +3415,13 @@ class DeviceChecker:
             # flush telemetry deltas continue from the frame's counts,
             # not from zero (a resumed run must not re-report them)
             self._fpm_prev = fpset.fpm_logical(fpm)
+        if self.fuse == "level":
+            # work counters restart after resume (frames don't carry
+            # them — the same regime as the r8 counter widenings);
+            # attribution of a resumed run covers the resumed portion
+            st["wkm"] = jnp.zeros((WKM_N,), jnp.int32)
+            self._wkm_prev = np.zeros((fpset.WKM_LOGICAL_N,), np.int64)
+        self._work_nv_prev = nv  # restored states are not appends
         if "hbm_recovered" in d:
             self.rec.hbm_recovered = max(
                 self.rec.hbm_recovered, int(d["hbm_recovered"])
@@ -3363,7 +3479,11 @@ class DeviceChecker:
         strictly-increasing / sizes-match-result contract."""
         wall = time.time() - t0
         self._snap.update(
-            level=level, frontier=int(nf), distinct_states=int(nv)
+            level=level, frontier=int(nf), distinct_states=int(nv),
+            # the heartbeat marks its line when the newest record was
+            # an intra-level anchor (r14 satellite: ramp-batch fetches
+            # make level/frontier figures mid-flight)
+            partial=bool(partial),
         )
         self.tel.emit(
             "level",
@@ -3529,6 +3649,17 @@ class DeviceChecker:
                 res.trace, res.trace_actions = self._trace(
                     bufs, gid, len(level_sizes) + 2
                 )
+        # fused-era cost attribution (r14): one machine-readable record
+        # of the per-stage work-unit totals right before the result —
+        # the input obs/attribution.py prices with the calibrated
+        # per-backend unit costs
+        work = {
+            k[len("work_"):]: int(v)
+            for k, v in self.last_stats.items()
+            if k.startswith("work_")
+        }
+        if work:
+            self.tel.emit("attribution", stages=work)
         # the final stream record carries the whole last_stats dict
         # (stage counters/timings, rtt_s, fpset_*, ckpt_*) — the report
         # layer rebuilds the per-stage table and BENCH keys from it

@@ -563,6 +563,23 @@ class LivenessChecker:
         cap = self._table_cap(n)
         SF = self.SF
         G = self._sweep_group_size()
+        # sweep work units (r14, fused-era cost attribution): the
+        # per-chunk join pipeline's costs are trace-time constants —
+        # two (cap + NQ)-wide sorts (merge + payload), ``passes``
+        # doubling-shift gid-propagation sweeps over the same width,
+        # and one NQ-lane edge compaction — so the host accumulates
+        # them as each chunk is consumed (zero extra syncs; the
+        # per-chunk ``sweep`` records carry the cumulative totals and
+        # ``--attribution`` prices them per sub-stage)
+        NQ = SF * A
+        maxrun = min(NQ, self.max_run)
+        passes = 0
+        d_ = 1
+        while d_ <= maxrun:
+            passes += 1
+            d_ <<= 1
+        chunk_sort = 2 * (cap + NQ)
+        chunk_prop = passes * (cap + NQ)
         # the last group's scan windows may run past the table cap;
         # pad the flat rows so no dynamic_slice can clamp (the overrun
         # chunks' lanes are masked dead and compact to zero kept)
@@ -655,6 +672,10 @@ class LivenessChecker:
                 # the group planes were already materialized above) +
                 # the stream record
                 swept = min(start + SF, n)
+                self._work_sweep["sort_lanes"] += chunk_sort
+                self._work_sweep["prop_lanes"] += chunk_prop
+                self._work_sweep["prop_passes"] += passes
+                self._work_sweep["compact_elems"] += NQ
                 self._snap.update(
                     distinct_states=n, level=i + 1, generated=n_edges
                 )
@@ -666,6 +687,11 @@ class LivenessChecker:
                     edges=n_edges,
                     group=G,
                     wall_s=round(time.time() - self._t0, 3),
+                    # cumulative sweep work units (v7)
+                    sort_lanes=self._work_sweep["sort_lanes"],
+                    prop_lanes=self._work_sweep["prop_lanes"],
+                    prop_passes=self._work_sweep["prop_passes"],
+                    compact_elems=self._work_sweep["compact_elems"],
                 )
                 done = i + 1 >= len(starts)
                 preempt = (
@@ -844,6 +870,12 @@ class LivenessChecker:
         self._ckpt_bytes = 0
         self._ckpt_write_s = 0.0
         self._ckpt_retries = 0
+        # per-run sweep work units (r14) — restart on resume, like the
+        # engine work counters
+        self._work_sweep = {
+            "sort_lanes": 0, "prop_lanes": 0, "prop_passes": 0,
+            "compact_elems": 0,
+        }
         # a crash mid-frame-write can leave a dead tmp file behind
         ckpt.cleanup_stale_tmp(self.checkpoint_path)
         # crash breadcrumbs FIRST: fault events flush before the fault
@@ -905,6 +937,16 @@ class LivenessChecker:
                         truncated=True,
                         stop_reason="preempted",
                     )
+                if any(self._work_sweep.values()):
+                    # the sweep's per-stage work totals, machine-
+                    # readable for the attribution layer (r14)
+                    self.tel.emit(
+                        "attribution",
+                        stages={
+                            f"sweep_{k}": int(v)
+                            for k, v in self._work_sweep.items()
+                        },
+                    )
                 self.tel.emit(
                     "result",
                     distinct_states=lres.distinct_states,
@@ -918,6 +960,11 @@ class LivenessChecker:
                     fairness=self.fairness,
                     ckpt_frames=self._ckpt_frames,
                     ckpt_retries=self._ckpt_retries,
+                    **{
+                        f"work_sweep_{k}": int(v)
+                        for k, v in self._work_sweep.items()
+                        if v
+                    },
                 )
                 return lres
         except BaseException as e:

@@ -163,10 +163,31 @@ def _engine_families(
         "ptt_stats_fetches_total", "counter",
         "Hot-path device stats fetches (the one engine sync)",
     ).add(stats.get("stats_fetches"))
+    # fused-era work units (r14): the in-kernel per-stage counters the
+    # cost-attribution model prices — a dashboard can watch work per
+    # state drift without any stage-timing rerun
+    work_fams = [
+        Family(
+            "ptt_work_expand_rows_total", "counter",
+            "Live frontier rows fed through expand windows",
+        ).add(stats.get("work_expand_rows")),
+        Family(
+            "ptt_work_probe_lanes_total", "counter",
+            "Candidate lanes presented to the fpset flush",
+        ).add(stats.get("work_probe_lanes")),
+        Family(
+            "ptt_work_compact_elems_total", "counter",
+            "Elements moved by stream compaction",
+        ).add(stats.get("work_compact_elems")),
+        Family(
+            "ptt_work_append_rows_total", "counter",
+            "Deduped rows landed by the append stage",
+        ).add(stats.get("work_append_rows")),
+    ]
     return [
         f_distinct, f_rate, f_level, f_frontier, f_occ, f_probe,
         f_lanes, f_flushes, f_hbm, f_frames, f_stall, f_fetches,
-    ]
+    ] + work_fams
 
 
 # ------------------------------------------------------- daemon scrape
@@ -286,8 +307,18 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     lanes = flushes = frames = 0
     stall = 0.0
     hbm = 0
+    work: Dict[str, int] = {}
     for e in events:
         ev = e.get("event")
+        if ev == "fuse":
+            # per-dispatch work deltas (v7): the event-sum fallback so
+            # a crashed run's stream still exports ptt_work_* families
+            for k in (
+                "work_expand_rows", "work_probe_lanes",
+                "work_compact_elems", "work_append_rows",
+            ):
+                if isinstance(e.get(k), (int, float)):
+                    work[k] = work.get(k, 0) + int(e[k])
         if ev == "level":
             last_level = e
         elif ev == "progress":
@@ -326,6 +357,8 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     stats.setdefault("ckpt_frames", frames or None)
     stats.setdefault("ckpt_write_s", round(stall, 3) if frames else None)
     stats.setdefault("hbm_recovered", hbm or None)
+    for k, v in work.items():
+        stats.setdefault(k, v or None)
 
     fams = _engine_families(stats, snap)
 

@@ -178,7 +178,7 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
     out: Dict[str, object] = {
         k: v
         for k, v in stats.items()
-        if k.startswith(("fpset_", "ckpt_"))
+        if k.startswith(("fpset_", "ckpt_", "work_"))
     }
     for k in (
         "distinct_states", "diameter", "wall_s", "states_per_sec",

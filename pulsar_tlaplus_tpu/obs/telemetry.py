@@ -67,10 +67,20 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # validator additionally cross-checks a fused run's boundary level
 # records against the result's ``level_sizes`` (strictly increasing
 # levels, per-level sizes summing to the distinct-state count).
+# v7 (round 14, fused-era cost attribution): ``fuse`` records carry
+# per-dispatch work-unit deltas (``work_expand_rows``,
+# ``work_probe_lanes``, ``work_compact_elems``, ``work_append_rows``)
+# accumulated INSIDE the megakernel's while loop and riding the one
+# stats fetch; engines emit one ``attribution`` record (the per-stage
+# work-unit totals, the machine-readable input to the calibrated cost
+# model in ``obs/attribution.py``) before the result; the liveness
+# sweep's ``sweep`` records carry cumulative sweep work units
+# (``sort_lanes``, ``prop_lanes``, ``compact_elems``); result stats
+# carry the ``work_*`` totals.
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -110,6 +120,18 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     # older streams using the name validator-clean.
     ("fuse", "levels"): 6,
     ("fuse", "dispatches"): 6,
+    # v7 (round 14): in-kernel work-unit deltas on every fuse record,
+    # cumulative sweep work units on sweep records, and the new
+    # ``attribution`` per-stage work-total record — all gated so every
+    # existing v6-and-older stream stays validator-clean.
+    ("fuse", "work_expand_rows"): 7,
+    ("fuse", "work_probe_lanes"): 7,
+    ("fuse", "work_compact_elems"): 7,
+    ("fuse", "work_append_rows"): 7,
+    ("sweep", "sort_lanes"): 7,
+    ("sweep", "prop_lanes"): 7,
+    ("sweep", "compact_elems"): 7,
+    ("attribution", "stages"): 7,
 }
 EVENTS: Dict[str, Tuple[str, ...]] = {
     # run lifecycle
@@ -130,8 +152,17 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "compact": ("dispatches", "impl"),
     # fused level megakernel (r13): one record per dispatch — levels
     # closed inside the dispatch (>1 = a ramp batch) and the flush
-    # groups it ran; the dispatch-count regression signal
-    "fuse": ("levels", "dispatches"),
+    # groups it ran; the dispatch-count regression signal.  v7 (r14):
+    # per-dispatch work-unit deltas from the in-kernel counters — the
+    # cost-attribution inputs a fused run carries without a stage rerun
+    "fuse": (
+        "levels", "dispatches", "work_expand_rows", "work_probe_lanes",
+        "work_compact_elems", "work_append_rows",
+    ),
+    # fused-era cost attribution (r14): the per-stage work-unit totals
+    # a run accumulated — the machine-readable input to the calibrated
+    # cost model (obs/attribution.py); one record right before result
+    "attribution": ("stages",),
     # survivability (r9: ``retries`` is the frame writer's
     # transient-failure retry count — the ckpt_retries breadcrumb)
     "ckpt_frame": (
@@ -139,8 +170,14 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     ),
     "hbm_recovery": ("recovery_n",),
     "fault": ("kind", "site", "count"),
-    # liveness edge-sweep progress (r9): one record per sweep chunk
-    "sweep": ("chunk", "chunks", "swept", "edges"),
+    # liveness edge-sweep progress (r9): one record per sweep chunk.
+    # v7 (r14): cumulative sweep work units — merged-sort lanes,
+    # gid-propagation pass-lanes, edge-compaction elements — the
+    # sweep's cost-attribution inputs
+    "sweep": (
+        "chunk", "chunks", "swept", "edges", "sort_lanes", "prop_lanes",
+        "compact_elems",
+    ),
     # legacy differential stage timings (PTT_STAGE_TIMING runs)
     "stage_timing": ("stages",),
     # checking-as-a-service job lifecycle (r11, service/scheduler.py):
@@ -305,6 +342,16 @@ class Heartbeat:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.beats = 0
+        # EWMA-smoothed rate (r14): fused dispatches close up to 8 ramp
+        # levels between stats fetches, so the raw beat-over-beat rate
+        # lurches at every fetch; the exponentially weighted average is
+        # what the line and the ETA report.  None until the first beat.
+        self.ewma_sps: Optional[float] = None
+
+    # EWMA weight of the newest beat-over-beat rate sample: ~0.3 keeps
+    # the line responsive (half-life ~2 beats) while absorbing the
+    # fuse-batch sawtooth
+    EWMA_ALPHA = 0.3
 
     def _emit_line(self, msg: str) -> None:
         if self._log is not None:
@@ -325,16 +372,31 @@ class Heartbeat:
         avg_sps = nv / elapsed
         dt = max(now - prev[0], 1e-9)
         recent_sps = max(nv - prev[1], 0) / dt
+        # EWMA across fuse batches (r14): a ramp dispatch lands up to
+        # 8 levels of states in one fetch, so the raw sample sawtooths;
+        # smooth it and drive the ETA from the smoothed estimate
+        if self.ewma_sps is None:
+            self.ewma_sps = recent_sps
+        else:
+            self.ewma_sps = (
+                self.EWMA_ALPHA * recent_sps
+                + (1.0 - self.EWMA_ALPHA) * self.ewma_sps
+            )
+        # the engine tags its snapshot ``partial`` when the last level
+        # record was an intra-level anchor — mark the line so a reader
+        # knows the level/frontier figures are mid-level
+        partial = bool(self.snap.get("partial"))
         eta_s = None
-        if self.capacity and recent_sps > 0:
-            eta_s = (self.capacity - nv) / recent_sps
+        if self.capacity and self.ewma_sps > 0:
+            eta_s = (self.capacity - nv) / self.ewma_sps
         msg = (
-            f"Progress({level if level is not None else '?'}) at "
-            f"{elapsed:.0f}s: "
+            f"Progress({level if level is not None else '?'}"
+            + ("~" if partial else "")
+            + f") at {elapsed:.0f}s: "
             + (f"{int(gen):,} states generated, " if gen is not None else "")
             + f"{nv:,} distinct states"
             + (f", frontier {int(frontier):,}" if frontier is not None else "")
-            + f", {recent_sps:,.0f} st/s (avg {avg_sps:,.0f})"
+            + f", {self.ewma_sps:,.0f} st/s (avg {avg_sps:,.0f})"
             + (f", fpset occupancy {occ:.1%}" if occ is not None else "")
             + (
                 f", ~{eta_s:.0f}s to the state cap"
@@ -347,7 +409,9 @@ class Heartbeat:
             "progress",
             distinct_states=nv,
             states_per_sec=round(recent_sps, 1),
+            states_per_sec_ewma=round(self.ewma_sps, 1),
             avg_states_per_sec=round(avg_sps, 1),
+            **({"partial": True} if partial else {}),
             **({"generated": int(gen)} if gen is not None else {}),
             **({"level": level} if level is not None else {}),
             **(

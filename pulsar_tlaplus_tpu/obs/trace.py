@@ -282,6 +282,23 @@ def _engine_track_events(
                     {"dispatches": e.get("dispatches", 0)},
                 )
             )
+        elif ev == "fuse":
+            # attribution counter tracks (r14): the megakernel's
+            # per-dispatch work-unit deltas render as stacked counters
+            # beside the level spans, so Perfetto shows WHERE the work
+            # inside the one dispatch went
+            vals = {
+                k[len("work_"):]: e[k]
+                for k in (
+                    "work_expand_rows", "work_probe_lanes",
+                    "work_compact_elems", "work_append_rows",
+                )
+                if isinstance(e.get(k), (int, float))
+            }
+            if vals:
+                out.append(
+                    _counter(pid, tid, "fused work units", t + off, vals)
+                )
         elif ev == "hbm_recovery":
             out.append(
                 _instant(

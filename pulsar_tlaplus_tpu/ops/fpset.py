@@ -124,6 +124,82 @@ def fpm_logical(vec):
         [v[0], v[1], v[2], (v[5] << 32) | lo, v[4]], np.int64
     )
 
+# Width of the zero-sync WORK-UNIT vector (r14, fused-era cost
+# attribution): the level megakernel accumulates per-stage work units
+# inside its ``lax.while_loop`` body and returns them in the packed
+# stats vector, so a single fused run carries enough information to
+# attribute per-stage cost WITHOUT the ``-fuse stage`` differential
+# rerun the r13 fusion destroyed.  Layout: [expand_rows,
+# probe_lanes_lo, compact_elems_lo, append_rows, groups,
+# probe_lanes_hi, compact_elems_hi].
+#
+# - ``expand_rows``: live frontier rows fed through expand windows
+#   (masked past-frontier window tails are constant-factor overhead the
+#   calibration absorbs); per level this sums to the frontier size, so
+#   the run total is bounded by max_states + slack and fits int32.
+# - ``probe_lanes``: lanes PRESENTED to the fpset flush — the full
+#   accumulator width per flush dispatch, because the dense probe cost
+#   is O(nq) per round whether a lane is valid or parked (valid-lane
+#   counts live in the fpm vector).  Outgrows int32 on 1B-state runs,
+#   so it carries hi/lo uint32 words (the r12 ``fpm_update`` pattern).
+# - ``compact_elems``: lanes presented to ``compact.compact_rows``
+#   (one row-matrix compaction per flush); hi/lo like probe_lanes.
+# - ``append_rows``: deduped new states landed by the append body
+#   (bounded by max_states — int32 safe).
+# - ``groups``: flush-group while-iterations the megakernel ran (the
+#   per-batch iteration count; the stage chain's equivalent is its
+#   flush dispatch count).
+#
+# The counters are defined so the fused totals EQUAL the ``-fuse
+# stage`` host dispatch-chain counts exactly (the differential parity
+# tests pin it): rows = sum of live window rows, lanes/elems =
+# accumulator width x flush count, appends = deduped states.
+WKM_N = 7
+
+# host-side LOGICAL view: [expand_rows, probe_lanes (64-bit),
+# compact_elems (64-bit), append_rows, groups]
+WKM_LOGICAL_N = 5
+
+
+def wkm_update(wkm, rows, lanes, elems, appended, groups):
+    """One flush group's device-side work-unit update (jit-traceable,
+    called inside the fused megakernel's while body).  ``lanes`` and
+    ``elems`` accumulate into uint32 lo words with the carry landing in
+    the hi words (bitcast storage, the :func:`fpm_update` pattern) so
+    1B-state runs report honest work totals instead of wrapped ones."""
+    lo_l = lax.bitcast_convert_type(wkm[1], jnp.uint32)
+    new_l = lo_l + lanes.astype(jnp.uint32)
+    carry_l = (new_l < lo_l).astype(jnp.int32)
+    lo_e = lax.bitcast_convert_type(wkm[2], jnp.uint32)
+    new_e = lo_e + elems.astype(jnp.uint32)
+    carry_e = (new_e < lo_e).astype(jnp.int32)
+    return jnp.stack(
+        [
+            wkm[0] + rows,
+            lax.bitcast_convert_type(new_l, jnp.int32),
+            lax.bitcast_convert_type(new_e, jnp.int32),
+            wkm[3] + appended,
+            wkm[4] + groups,
+            wkm[5] + carry_l,
+            wkm[6] + carry_e,
+        ]
+    )
+
+
+def wkm_logical(vec):
+    """int64[WKM_LOGICAL_N] logical view of a fetched work vector:
+    [expand_rows, probe_lanes, compact_elems, append_rows, groups]
+    with the hi/lo words reassembled into 64-bit counts."""
+    import numpy as np
+
+    a = np.asarray(vec, np.int64).reshape(-1)
+    v = np.zeros((WKM_N,), np.int64)
+    v[: min(len(a), WKM_N)] = a[:WKM_N]
+    lanes = (v[5] << 32) | np.int64(np.uint32(v[1] & 0xFFFFFFFF))
+    elems = (v[6] << 32) | np.int64(np.uint32(v[2] & 0xFFFFFFFF))
+    return np.array([v[0], lanes, elems, v[3], v[4]], np.int64)
+
+
 MAX_PROBES = 64
 # staged-compaction schedule for the engine hot path: a few dense
 # rounds, then (shrink divisor, probe-round limit) per stage.  At load

@@ -34,13 +34,19 @@ engine's ``fuse`` megakernel records, gated at v6, and a fused-run
 CROSS-CHECK — every run whose header declares ``fuse: "level"`` must
 carry strictly increasing boundary ``level`` records whose per-level
 sizes match the result's ``level_sizes`` and, on clean runs, sum to
-its distinct-state count).  ``--trace``
+its distinct-state count; r14: v7 ``fuse`` records carry per-dispatch
+work-unit deltas, ``sweep`` records cumulative sweep work units, and
+the new ``attribution`` record the per-stage work totals — all
+FIELD_SINCE-gated so older streams stay clean).  ``--trace``
 validates an exported Perfetto trace file's event structure instead
-(obs/trace.py).  Bench rules: ``bench_schema`` >= 2 requires the
+(obs/trace.py); ``--ledger`` validates cross-run regression ledger
+files (obs/ledger.py — record structure + digest integrity).  Bench
+rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
 ``ckpt_retries``, >= 5 additionally ``compact_impl``, >= 6
-additionally ``fuse`` + ``dispatches_per_level``.
+additionally ``fuse`` + ``dispatches_per_level``, >= 7 additionally
+the ``work_*`` unit totals (r14 attribution).
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -85,6 +91,12 @@ BENCH_KEYS_V5 = BENCH_KEYS_V4 + ("compact_impl",)
 # v6 (r13): the level-fusion mode and the run's dispatch economy (the
 # fused-vs-stage differential headline)
 BENCH_KEYS_V6 = BENCH_KEYS_V5 + ("fuse", "dispatches_per_level")
+# v7 (r14): the in-kernel work-unit totals the cost-attribution model
+# prices (docs/observability.md "Attribution")
+BENCH_KEYS_V7 = BENCH_KEYS_V6 + (
+    "work_expand_rows", "work_probe_lanes", "work_compact_elems",
+    "work_append_rows", "work_groups",
+)
 
 
 def _check_fused_levels(path: str, runs: dict) -> List[str]:
@@ -261,7 +273,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 6:
+    if schema >= 7:
+        required = BENCH_KEYS_V7
+    elif schema >= 6:
         required = BENCH_KEYS_V6
     elif schema >= 5:
         required = BENCH_KEYS_V5
@@ -296,6 +310,12 @@ def main(argv=None) -> int:
         help="treat the .json files as exported Perfetto traces "
         "(cli.py trace output) and validate their event structure",
     )
+    ap.add_argument(
+        "--ledger", action="store_true",
+        help="treat the .jsonl files as cross-run regression ledgers "
+        "(cli.py ledger output) and validate their record structure "
+        "+ digest integrity instead of the telemetry stream schema",
+    )
     args = ap.parse_args(argv)
     files = list(args.files)
     if args.all_bench:
@@ -308,7 +328,14 @@ def main(argv=None) -> int:
     errors: List[str] = []
     for p in files:
         if p.endswith(".jsonl"):
-            errors += validate_stream(p)
+            if args.ledger:
+                from pulsar_tlaplus_tpu.obs.ledger import (
+                    validate_ledger,
+                )
+
+                errors += validate_ledger(p)
+            else:
+                errors += validate_stream(p)
         elif args.trace:
             from pulsar_tlaplus_tpu.obs.trace import validate_trace
 

@@ -12,6 +12,8 @@ in one place:
     python scripts/profile.py stages  [--sub-batch-log2 19] [--run S]
     python scripts/profile.py lsm     [--section sort|sort4|gather|scatter]
     python scripts/profile.py bucket
+    python scripts/profile.py calibrate [--out calibration.json]  # r14:
+        # unit costs for the work-unit cost-attribution model
 
 Mapping from the retired scripts:
 
@@ -705,6 +707,105 @@ def cmd_bucket(_args):
                lambda o, a: ((a[0] ^ (o & 0)).astype(jnp.int32),), k=4)
 
 
+# ---------------------------------------------------------- calibrate
+
+
+def cmd_calibrate(args):
+    """Write ``calibration.json`` for the fused-era cost-attribution
+    model (obs/attribution.py, round 14): run the ``-fuse stage``
+    dispatch chain under ``PTT_STAGE_TIMING=1`` on a reference config,
+    divide each stage's RTT-corrected measured seconds by the run's
+    own work-unit counts, and persist the per-backend ns/unit costs.
+    ``telemetry_report.py --attribution --calibration FILE`` then
+    prices any single fused run's work counters — no stage rerun.
+
+        python scripts/profile.py calibrate                 # 45k oracle
+        python scripts/profile.py calibrate --config small  # 1.7k smoke
+        python scripts/profile.py calibrate --sweep         # + liveness
+
+    The stage-timing barrier serializes the pipeline, so this is a
+    measurement run, not a benchmark — expect it to be slower than a
+    normal check of the same config.
+    """
+    import tempfile
+
+    # the barrier flag is read at CHECKER CONSTRUCTION, so it must be
+    # in the environment before the import-side ctor below
+    os.environ["PTT_STAGE_TIMING"] = "1"
+
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.obs import attribution, report
+    from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+    if args.config == "small":
+        c = pe.Constants(
+            message_sent_limit=2, compaction_times_limit=2,
+            num_keys=1, num_values=1, max_crash_times=1,
+            model_producer=True,
+        )
+        kw = dict(sub_batch=256, visited_cap=1 << 12,
+                  frontier_cap=1 << 12)
+    else:  # the shipped 45,198-state reference binding
+        c = pe.SHIPPED_CFG
+        kw = dict(sub_batch=2048, visited_cap=1 << 16,
+                  frontier_cap=1 << 15)
+    stream = os.path.join(
+        tempfile.gettempdir(), f"calibrate_{os.getpid()}.jsonl"
+    )
+    try:
+        os.remove(stream)
+    except OSError:
+        pass
+    print(f"calibration run: -fuse stage + PTT_STAGE_TIMING on "
+          f"{'small' if args.config == 'small' else 'shipped'} config",
+          file=sys.stderr)
+    ck = DeviceChecker(
+        CompactionModel(c), invariants=(), fuse="stage",
+        telemetry=stream, **kw,
+    )
+    ck.warmup(tiers=False)
+    r = ck.run()
+    print(f"  {r.distinct_states} states in {r.wall_s:.1f}s "
+          "(barrier-serialized)", file=sys.stderr)
+    events, _errs = report.load_events(stream)
+    cal = attribution.calibrate_from_events(
+        events, label=f"profile.py calibrate ({args.config})"
+    )
+    if args.sweep:
+        from pulsar_tlaplus_tpu.engine.liveness import LivenessChecker
+
+        sweep_stream = stream + ".sweep"
+        lck = LivenessChecker(
+            CompactionModel(c), goal="Termination",
+            fairness="wf_next", telemetry=sweep_stream,
+            frontier_chunk=kw["sub_batch"],
+            visited_cap=kw["visited_cap"],
+        )
+        lres = lck.run()
+        print(f"  sweep calibration: {lres.distinct_states} states "
+              f"({lres.reason[:60]})", file=sys.stderr)
+        sweep_events, _e = report.load_events(sweep_stream)
+        cal = attribution.sweep_calibrate_from_events(
+            sweep_events, cal
+        )
+        try:
+            os.remove(sweep_stream)
+        except OSError:
+            pass
+    attribution.save_calibration(args.out, cal)
+    try:
+        os.remove(stream)
+    except OSError:
+        pass
+    print(f"wrote {args.out}:")
+    for k, v in sorted(cal["units"].items()):
+        print(f"  {k:20s} {v:10.2f}")
+    print(f"  (measured stages: {cal.get('measured_stages')}; "
+          f"defaults kept for: {cal.get('defaulted_stages')})")
+    return 0
+
+
 # --------------------------------------------------------------- main
 
 
@@ -750,6 +851,23 @@ def main(argv=None):
 
     pb = sub.add_parser("bucket", help="bucketized-hash primitives")
     pb.set_defaults(fn=cmd_bucket)
+
+    pc = sub.add_parser(
+        "calibrate",
+        help="write calibration.json for the fused-era cost-"
+        "attribution model: a -fuse stage + PTT_STAGE_TIMING "
+        "reference run divided by its own work-unit counts "
+        "(docs/observability.md \"Attribution\")")
+    pc.add_argument("--out", default="calibration.json",
+                    help="output file (default ./calibration.json)")
+    pc.add_argument("--config", choices=["shipped", "small"],
+                    default="shipped",
+                    help="reference config: shipped 45,198-state "
+                    "binding (default) or the small 1,654-state smoke")
+    pc.add_argument("--sweep", action="store_true",
+                    help="also run a liveness check and calibrate the "
+                    "sweep unit cost from its measured sweep wall")
+    pc.set_defaults(fn=cmd_calibrate)
 
     args = ap.parse_args(argv)
     return args.fn(args) or 0

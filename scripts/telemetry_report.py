@@ -61,6 +61,20 @@ def main(argv=None) -> int:
         "suspend/restore overhead columns — docs/service.md)",
     )
     ap.add_argument(
+        "--attribution", action="store_true",
+        help="render the per-stage COST-ATTRIBUTION table from the "
+        "run's work-unit counters (v7): a single default-mode fused "
+        "run reproduces the BASELINE per-stage shape with no "
+        "PTT_STAGE_TIMING / -fuse stage rerun "
+        "(docs/observability.md \"Attribution\")",
+    )
+    ap.add_argument(
+        "--calibration", default=None, metavar="FILE",
+        help="calibration.json with per-backend unit costs "
+        "(scripts/profile.py calibrate); default: built-in "
+        "backend defaults, footnoted as uncalibrated",
+    )
+    ap.add_argument(
         "--trace", default=None, metavar="OUT.json",
         help="export the stream(s) as Perfetto-loadable Chrome trace "
         "JSON instead of tables (obs/trace.py; --compare streams "
@@ -101,6 +115,17 @@ def main(argv=None) -> int:
 
     if args.jobs:
         print(report.render_job_table(streams[0][1]))
+        return 0
+
+    if args.attribution:
+        from pulsar_tlaplus_tpu.obs import attribution
+
+        cal = (
+            attribution.load_calibration(args.calibration)
+            if args.calibration
+            else None
+        )
+        print(attribution.render_attribution(streams, cal))
         return 0
 
     hd = report.header(streams[0][1])
