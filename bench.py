@@ -308,6 +308,15 @@ def parse_args(argv=None):
         "(default auto, up to 8; 1 disables batching)",
     )
     ap.add_argument(
+        "--profile", default="auto", metavar="AUTO|NONE|FILE",
+        help="tuned-profile resolution (docs/tuning.md): 'auto' "
+        "(default) looks the bench workload's profile up by config "
+        "signature in PTT_TUNE_DIR and lets its knobs override the "
+        "hand defaults above (explicit CLI flags still win); 'none' "
+        "disables; a path loads that profile file.  The artifact "
+        "records profile_sig either way",
+    )
+    ap.add_argument(
         "--checkpoint", default=None,
         help="write level-boundary checkpoint frames to this .npz "
         "(survivable bench runs: SIGTERM/SIGINT exit resumably, HBM "
@@ -414,6 +423,38 @@ def main(argv=None):
     # candidates instead of per 8.9M).
     kw = dict(BENCH_CHECKER_KW)
     kw["max_states"] = args.max_states
+    # tuned-profile resolution (r15, docs/tuning.md): the profile's
+    # knobs replace the HAND defaults above — that is the point of
+    # the tuner — but explicit CLI flags still win, and the engine
+    # re-validates the profile against its own config signature
+    prof = None
+    if args.profile != "none":
+        from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
+
+        prof = tune_profiles.resolve(
+            "auto" if args.profile == "auto" else args.profile,
+            model=model,
+            invariants=tuple(
+                getattr(model, "default_invariants", ())
+            ),
+            engine="device_bfs",
+        )
+    if prof:
+        pk = tune_profiles.knobs_for(prof, "device_bfs")
+        user_set = set()
+        if args.fuse_group is not None:
+            user_set.add("fuse_group")
+        if args.compact != "logshift":
+            user_set.add("compact_impl")
+        for k, v in sorted(pk.items()):
+            if k == "adapt" or k in user_set:
+                continue
+            kw[k] = v
+            print(
+                f"bench: tuned knob {k}={v} "
+                f"(profile {prof['sig']})",
+                file=sys.stderr,
+            )
     xprof_window = None
     if args.xprof_levels:
         from pulsar_tlaplus_tpu.obs.telemetry import parse_level_window
@@ -428,9 +469,10 @@ def main(argv=None):
         progress=True,
         metrics_path=metrics_path,
         visited_impl=args.visited,
-        compact_impl=args.compact,
+        compact_impl=kw.pop("compact_impl", args.compact),
         fuse=args.fuse,
-        fuse_group=args.fuse_group,
+        fuse_group=kw.pop("fuse_group", args.fuse_group),
+        profile=prof,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         telemetry=args.telemetry,
@@ -657,6 +699,10 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # stage chain under --visited sort, and the artifact
                 # must report the mode that actually ran
                 "fuse": ck.fuse,
+                # tuned-profile attribution (r15): null on untuned
+                # runs — lets `ledger compare/gate` split tuned vs
+                # default bench trajectories (docs/tuning.md)
+                "profile_sig": ck.profile_sig,
                 "dispatches_per_level": stat("dispatches_per_level"),
                 "stage_fused_n": stat("stage_fused_n"),
                 "fuse_levels": stat("fuse_levels"),

@@ -158,9 +158,11 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         progress=True,
         metrics_path=args.metrics,
         visited_impl=args.visited,
-        compact_impl=args.compact,
+        compact_impl=_tunable(args, "compact", args.compact),
         fuse=args.fuse,
         fuse_group=args.fuse_group,
+        profile=_profile_arg(args),
+        adapt=_adapt_arg(args),
         telemetry=args.telemetry,
         heartbeat_s=args.progress,
         xprof_dir=args.xprof,
@@ -301,7 +303,8 @@ def _check_properties(args, model, properties, rc):
                     # states location per invocation)
                     checkpoint_path=args.checkpoint,
                     sweep_group=args.sweep_group,
-                    compact_impl=args.compact,
+                    compact_impl=_tunable(args, "compact", args.compact),
+                    profile=_profile_arg(args),
                     telemetry=args.telemetry,
                     heartbeat_s=args.progress,
                     progress=True,
@@ -326,6 +329,38 @@ def _check_properties(args, model, properties, rc):
         if not lres.holds:
             rc = 1
     return rc
+
+
+# argparse defaults for the tuned knobs ("explicit flags still win":
+# a flag left at its default counts as unset, so a tuned profile may
+# fill it — docs/tuning.md.  An explicitly typed default value is
+# indistinguishable from the default; pass -no-profile to pin it.)
+# NOTE `-chunk` is NOT here: its CLI default (sub_batch 4096) differs
+# from the engine default (8192), so treating it as "unset" would
+# silently change every untuned check's geometry — `cli check` always
+# passes sub_batch explicitly, and sub_batch stays tunable through
+# bench/tune/serve, whose defaults ARE the engine's (docs/tuning.md).
+_TUNABLE_DEFAULTS = {"compact": "logshift"}
+
+
+def _tunable(args, name, value):
+    """None (profile-resolvable) when the flag sits at its argparse
+    default, else the explicit value."""
+    if getattr(args, name) == _TUNABLE_DEFAULTS[name]:
+        return None
+    return value
+
+
+def _profile_arg(args):
+    return None if getattr(args, "no_profile", False) else "auto"
+
+
+def _adapt_arg(args):
+    if getattr(args, "no_adapt", False):
+        return False
+    if getattr(args, "adapt", False):
+        return True
+    return None  # profile/env decides (tune/online.py)
 
 
 def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
@@ -368,7 +403,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
                 max_states=args.maxstates,
                 checkpoint_path=args.checkpoint,
                 sweep_group=args.sweep_group,
-                compact_impl=args.compact,
+                compact_impl=_tunable(args, "compact", args.compact),
+                profile=_profile_arg(args),
                 telemetry=args.telemetry,
                 heartbeat_s=args.progress,
                 progress=True,
@@ -475,9 +511,11 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             progress=True,
             metrics_path=args.metrics,
             visited_impl=args.visited,
-            compact_impl=args.compact,
+            compact_impl=_tunable(args, "compact", args.compact),
             fuse=args.fuse,
             fuse_group=args.fuse_group,
+            profile=_profile_arg(args),
+            adapt=_adapt_arg(args),
             checkpoint_path=args.checkpoint,
             telemetry=args.telemetry,
             heartbeat_s=args.progress,
@@ -651,6 +689,7 @@ def _cmd_serve(args) -> int:
         sub_batch=min(args.chunk, 4096),
         specs=tuple(args.spec or ()),
         prewarm_tiers=not args.no_tiers,
+        profiles="none" if args.no_profiles else "auto",
     )
     try:
         daemon = ServiceDaemon(config, recover=args.recover, log=log)
@@ -949,13 +988,22 @@ def _cmd_ledger(args) -> int:
                     (
                         r for r in reversed(recs[:cut])
                         if r.get("key") == cur.get("key")
+                        # tuned-vs-default context (r15): "same"
+                        # gates tuned against tuned and default
+                        # against default; "none" gates a tuned run
+                        # against the hand-default baseline — the
+                        # "tuning never regresses" check
+                        and ledger.baseline_matches_profile(
+                            r, args.profile, cur
+                        )
                     ),
                     None,
                 )
                 if base is None:
                     print(
                         "tpu-tlc: no baseline with a matching config "
-                        "key in the ledger (pass --baseline REF)",
+                        f"key and profile context ({args.profile!r}) "
+                        "in the ledger (pass --baseline REF)",
                         file=sys.stderr,
                     )
                     return 2
@@ -980,6 +1028,100 @@ def _cmd_ledger(args) -> int:
         print(f"tpu-tlc: {msg}", file=sys.stderr)
         return 2
     return 2
+
+
+def _cmd_tune(args) -> int:
+    """Offline autotune (docs/tuning.md): predict the knob space with
+    the calibrated cost model, measure the top-K survivors with short
+    interleaved runs, persist the winner as a tuned profile the
+    engines / bench / daemon resolve by config signature."""
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from pulsar_tlaplus_tpu.models import registry
+    from pulsar_tlaplus_tpu.obs import attribution, ledger
+    from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
+    from pulsar_tlaplus_tpu.tune import search as tune_search
+    from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+    module = args.spec
+    if module.endswith(".tla"):
+        module = os.path.splitext(os.path.basename(module))[0]
+    if module not in registry.COMPILED:
+        print(
+            f"tpu-tlc: tune needs a compiled-registry spec (known: "
+            f"{sorted(registry.COMPILED)}); got {args.spec!r}",
+            file=sys.stderr,
+        )
+        return 2
+    cfg_path = args.config or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "specs", f"{module}.cfg",
+    )
+    try:
+        tlc_cfg = cfgmod.load(cfg_path)
+        model, _constants = registry.COMPILED[module](tlc_cfg)
+    except (OSError, ValueError) as e:
+        print(f"tpu-tlc: {e}", file=sys.stderr)
+        return 2
+    invariants = tuple(args.invariant or tlc_cfg.invariants)
+    cal = None
+    if args.calibration:
+        try:
+            cal = attribution.load_calibration(args.calibration)
+        except (OSError, ValueError) as e:
+            print(f"tpu-tlc: {e}", file=sys.stderr)
+            return 2
+    stream_dir = args.stream_dir
+    if stream_dir is None and args.ledger:
+        import tempfile
+
+        stream_dir = tempfile.mkdtemp(prefix="ptt_tune_")
+
+    def log(msg: str) -> None:
+        print(f"tpu-tlc tune: {msg}", file=sys.stderr, flush=True)
+
+    try:
+        profile, rows = tune_search.tune_device(
+            model,
+            invariants=invariants,
+            spec_label=module,
+            base_kw=dict(
+                visited_cap=args.visited_cap,
+                frontier_cap=args.frontier_cap,
+                max_states=args.maxstates,
+            ),
+            budget_s=args.budget,
+            top_k=args.top_k,
+            repeat=args.repeat,
+            candidate_limit=args.candidates,
+            calibration=cal,
+            adapt=args.adapt,
+            stream_dir=stream_dir,
+            log=log,
+        )
+    except (ValueError, RuntimeError) as e:
+        print(f"tpu-tlc: tune failed: {e}", file=sys.stderr)
+        return 2
+    print(tune_search.render_report(profile, rows))
+    print(f"profile: {tune_profiles.path_for(profile['sig'])}")
+    if args.ledger and stream_dir:
+        import glob as globmod
+
+        recs = []
+        for p in sorted(
+            globmod.glob(os.path.join(stream_dir, "tune_*.jsonl"))
+        ):
+            try:
+                recs.append(ledger.record_from_file(p))
+            except (OSError, ValueError, json.JSONDecodeError):
+                continue
+        added = ledger.append(args.ledger, recs)
+        print(
+            f"ingested {added} measured run(s) into {args.ledger}"
+        )
+    return 0
 
 
 def _cmd_cache(args) -> int:
@@ -1073,6 +1215,12 @@ def main(argv=None):
         "--no-tiers", action="store_true",
         help="prewarm only the base capacity tier (faster startup, "
         "growth tiers lazy-compile)",
+    )
+    ps.add_argument(
+        "--no-profiles", action="store_true",
+        help="skip tuned-profile resolution when building pooled "
+        "checkers (profiles otherwise shape the prewarmed "
+        "executables; docs/tuning.md)",
     )
     ps.add_argument(
         "--recover", action="store_true",
@@ -1239,6 +1387,91 @@ def main(argv=None):
         "machine-independent choices: dispatches_per_level "
         "work_units_per_state)",
     )
+    plg.add_argument(
+        "--profile", default="same", metavar="CTX",
+        help="baseline profile context (default 'same': tuned gates "
+        "against tuned, default against default): 'none' = only "
+        "untuned baselines (is tuning a regression vs hand "
+        "defaults?), 'any' = ignore profile context, or a "
+        "profile-sig prefix",
+    )
+
+    ptn = sub.add_parser(
+        "tune",
+        help="cost-model-driven autotune: predict the knob space "
+        "(fuse_group, sub-batch, flush factor, fpset probe schedule, "
+        "compaction impl), measure the top-K candidates with short "
+        "interleaved runs, persist the winner as a tuned profile the "
+        "engines and the serve daemon resolve by config signature "
+        "(docs/tuning.md)",
+    )
+    ptn.add_argument(
+        "spec", help="compiled-registry spec name (or its .tla path)"
+    )
+    ptn.add_argument(
+        "-config", default=None,
+        help=".cfg constant bindings (default: specs/<spec>.cfg)",
+    )
+    ptn.add_argument(
+        "-invariant", action="append", default=None,
+        help="invariant set the tuned runs check (repeatable; "
+        "default: cfg INVARIANTS — part of the profile key)",
+    )
+    ptn.add_argument(
+        "--maxstates", type=int, default=1 << 22,
+        help="state budget per measured run (keep it short: the "
+        "tuner needs relative wall, not exhaustion)",
+    )
+    ptn.add_argument(
+        "--budget", type=float, default=None, metavar="SEC",
+        help="optional per-run time budget",
+    )
+    ptn.add_argument(
+        "--visited-cap", type=int, default=1 << 16,
+        help="initial visited-set tier for the measured runs",
+    )
+    ptn.add_argument(
+        "--frontier-cap", type=int, default=1 << 14,
+        help="initial row-store tier for the measured runs",
+    )
+    ptn.add_argument(
+        "--top-k", type=int, default=4,
+        help="candidates measured beyond the default baseline "
+        "(everything else is pruned by the cost-model prediction)",
+    )
+    ptn.add_argument(
+        "--repeat", type=int, default=2,
+        help="interleaved repetitions per measured candidate "
+        "(min-of-N; default 2)",
+    )
+    ptn.add_argument(
+        "--candidates", type=int, default=None,
+        help="cap the enumerated space (default: the whole space)",
+    )
+    ptn.add_argument(
+        "--calibration", default=None, metavar="FILE",
+        help="calibration.json from scripts/profile.py calibrate "
+        "(default: per-backend fallback unit costs)",
+    )
+    ptn.add_argument(
+        "--adapt", action="store_true",
+        help="write the profile with online adaptation enabled "
+        "(engines then run the dispatch-boundary controller; "
+        "PTT_TUNE_ADAPT=0 still kills it)",
+    )
+    ptn.add_argument(
+        "--stream-dir", default=None, metavar="DIR",
+        help="keep the measured runs' telemetry streams here",
+    )
+    ptn.add_argument(
+        "--ledger", default=None, metavar="FILE",
+        help="ingest every measured run into this ledger (tuned runs "
+        "carry profile_sig=null during the search; the WINNING "
+        "profile's later runs carry its sig)",
+    )
+    ptn.add_argument(
+        "-cpu", action="store_true", help="force the CPU backend"
+    )
 
     pch = sub.add_parser(
         "cache",
@@ -1326,6 +1559,29 @@ def main(argv=None):
         help="with -fuse level: max ramp levels batched into one "
         "dispatch (default: auto from the frontier size, up to 8; "
         "1 disables ramp batching)",
+    )
+    pc.add_argument(
+        "-no-profile",
+        dest="no_profile",
+        action="store_true",
+        help="skip tuned-profile resolution: run with the engine "
+        "defaults + explicit flags only (profiles otherwise resolve "
+        "by config signature from PTT_TUNE_DIR; docs/tuning.md)",
+    )
+    pc.add_argument(
+        "-adapt",
+        action="store_true",
+        help="enable online adaptation: a dispatch-boundary "
+        "controller nudges the fpset probe schedule and the ramp "
+        "batch cap from the streaming work counters (every change "
+        "is a telemetry 'tune' event; discovery order is unchanged)",
+    )
+    pc.add_argument(
+        "-no-adapt",
+        dest="no_adapt",
+        action="store_true",
+        help="force online adaptation OFF even when the tuned "
+        "profile enables it (PTT_TUNE_ADAPT=0 is the env equivalent)",
     )
     pc.add_argument(
         "-sweep-group",
@@ -1462,6 +1718,7 @@ def main(argv=None):
     if args.cmd != "check":
         return {
             "serve": _cmd_serve,
+            "tune": _cmd_tune,
             "submit": _cmd_submit,
             "status": _cmd_status,
             "watch": _cmd_watch,

@@ -376,6 +376,10 @@ class Checker:
             device=dev,
             visited_impl=self.dedup_mode,
             config_sig=self._config_sig(),
+            # v8 envelope: the host engine is never profile-tuned,
+            # but the field must exist so the ledger can split tuned
+            # vs default trajectories uniformly
+            profile_sig=None,
             wall_unix=round(time.time(), 3),
             max_states=self.max_states,
             invariants=list(self.invariant_names),

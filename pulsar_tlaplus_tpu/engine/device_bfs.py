@@ -79,6 +79,8 @@ from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.tune import online as tune_online
+from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
 from pulsar_tlaplus_tpu.utils import ckpt, device, faults, recovery
 from pulsar_tlaplus_tpu.utils.aot_cache import ajit
 from pulsar_tlaplus_tpu.ops import compact as compact_ops
@@ -133,7 +135,7 @@ class DeviceChecker:
         model,
         invariants: Optional[Tuple[str, ...]] = None,
         check_deadlock: bool = True,
-        sub_batch: int = 8192,
+        sub_batch: Optional[int] = None,
         expand_chunk: Optional[int] = None,
         visited_cap: int = 1 << 16,
         frontier_cap: Optional[int] = None,
@@ -141,19 +143,21 @@ class DeviceChecker:
         time_budget_s: Optional[float] = None,
         progress: bool = False,
         metrics_path: Optional[str] = None,
-        group: int = 4,
-        flush_factor: int = 1,
+        group: Optional[int] = None,
+        flush_factor: Optional[int] = None,
         fp_bits: Optional[int] = None,
         append_chunk: Optional[int] = None,
         seed_cap: Optional[int] = None,
         rows_window: str = "all",
         row_cap_states: Optional[int] = None,
         visited_impl: str = "fpset",
-        compact_impl: str = "logshift",
+        compact_impl: Optional[str] = None,
         fuse: str = "level",
         fuse_group: Optional[int] = None,
         fpset_dense_rounds: Optional[int] = None,
         fpset_stages=None,
+        profile=None,
+        adapt: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 5,
         telemetry=None,
@@ -188,6 +192,54 @@ class DeviceChecker:
         ):
             self.invariant_names += ("__EvalError__",)
         self.check_deadlock = check_deadlock
+        # Tuned-profile resolution (round 15, tune/profiles.py):
+        # explicit ctor knobs always win; knobs the caller left at
+        # their ``None`` sentinel take the resolved profile's value,
+        # then the engine default.  ``profile`` is None (off — direct
+        # constructions, tests), "auto" (look up by config signature),
+        # a path, or a profile dict; resolution failures warn and fall
+        # back — a tuned profile is an optimization, never a
+        # correctness dependency.
+        prof = tune_profiles.resolve(
+            profile, model=model, invariants=self.invariant_names,
+            engine="device_bfs",
+        )
+        self.profile_sig = prof["sig"] if prof else None
+        _pk = tune_profiles.knobs_for(prof, "device_bfs")
+        self.profile_applied = tuple(
+            sorted(
+                k for k in _pk
+                if k != "adapt"
+                and {
+                    "sub_batch": sub_batch,
+                    "flush_factor": flush_factor,
+                    "group": group,
+                    "fuse_group": fuse_group,
+                    "fpset_dense_rounds": fpset_dense_rounds,
+                    "fpset_stages": fpset_stages,
+                    "compact_impl": compact_impl,
+                }.get(k) is None
+            )
+        )
+        sub_batch = sub_batch or _pk.get("sub_batch") or 8192
+        group = group or _pk.get("group") or 4
+        flush_factor = flush_factor or _pk.get("flush_factor") or 1
+        compact_impl = (
+            compact_impl or _pk.get("compact_impl") or "logshift"
+        )
+        fuse_group = (
+            fuse_group if fuse_group is not None
+            else _pk.get("fuse_group")
+        )
+        if fpset_dense_rounds is None:
+            fpset_dense_rounds = _pk.get("fpset_dense_rounds")
+        if fpset_stages is None:
+            fpset_stages = _pk.get("fpset_stages")
+        # online adaptation (tune/online.py): env kill switch >
+        # explicit ctor/CLI choice > the profile's "adapt" knob
+        self.adapt = tune_online.resolve_adapt(
+            adapt, bool(_pk.get("adapt", False))
+        )
         self.A = model.A
         self.W = self.layout.W
         self.G = sub_batch
@@ -274,6 +326,13 @@ class DeviceChecker:
         self.fps_dense, self.fps_stages = fpset.resolve_schedule(
             fpset_dense_rounds, fpset_stages
         )
+        # online-adaptation state: the configured schedule is the
+        # per-run baseline (an adapted pooled checker must not leak
+        # its adjustments into the next job's run), and the ramp cap
+        # adapts within [1, RMAX] without re-jitting
+        self._fps_base = (self.fps_dense, self.fps_stages)
+        self._adapt_cap: Optional[int] = None
+        self._tuner = None
         if visited_impl == "fpset":
             t = 1 << 11
             while t < 2 * self.VCAP:
@@ -2062,6 +2121,21 @@ class DeviceChecker:
             self.last_stats.get("stage_compact_s", 0.0)
         )
         self._resume_meta = {}
+        # online adaptation (r15, tune/online.py): fresh controller
+        # per run, probe schedule reset to the configured baseline —
+        # an adapted pooled checker must not leak its adjustments
+        # into the next job's run
+        self.fps_dense, self.fps_stages = self._fps_base
+        self._adapt_cap = None
+        self._tuner = (
+            tune_online.OnlineController(
+                self.RMAX, self.fps_dense, self.fps_stages
+            )
+            if self.adapt
+            and self.fuse == "level"
+            and self.visited_impl == "fpset"
+            else None
+        )
         # per-run dispatch accounting baseline (the stage counters in
         # last_stats are lifetime-cumulative): dispatches_per_level in
         # the result reports THIS run's dispatch/level ratio, and
@@ -2155,6 +2229,11 @@ class DeviceChecker:
             rows_window=self.rows_window,
             invariants=list(self.invariant_names),
             resume=resume,
+            # tuned-profile attribution (r15, schema v8): None on
+            # untuned runs — the field itself is always present so
+            # the ledger can split tuned vs default trajectories
+            profile_sig=self.profile_sig,
+            adapt=self.adapt,
         )
         rm = self._resume_meta
         if resume and rm:
@@ -2933,7 +3012,14 @@ class DeviceChecker:
         if self.rows_window != "all" or nf > self.G:
             lv = 1
         else:
-            lv = self.RMAX
+            # the online controller's adapted ramp cap stays within
+            # [1, RMAX] — inside the compiled kernel's static ramp
+            # vector, so adaptation never re-jits this program
+            lv = (
+                self.RMAX
+                if self._adapt_cap is None
+                else max(1, min(self.RMAX, self._adapt_cap))
+            )
         if self.checkpoint_path:
             lv = min(
                 lv,
@@ -2952,6 +3038,32 @@ class DeviceChecker:
         if self.time_budget_s is not None:
             return max(8 * self.group, 32)
         return 1 << 30
+
+    def _apply_tune(self, adj: Dict) -> None:
+        """Apply one online-controller adjustment at the dispatch
+        boundary and emit the schema-v8 ``tune`` event.  ``fuse_cap``
+        adjusts within the compiled kernel's ramp vector (no re-jit);
+        ``fpset_dense_rounds`` re-keys the megakernel so the NEXT
+        dispatch pays one compile — still never mid-kernel, and
+        discovery order is schedule-independent (min-lane-wins dedup;
+        pinned in tests/test_tune.py)."""
+        knob, new = adj["knob"], adj["to"]
+        if knob == "fuse_cap":
+            self._adapt_cap = int(new)
+        elif knob == "fpset_dense_rounds":
+            self.fps_dense = int(new)
+        else:  # an unknown knob from a future controller: ignore
+            return
+        self.last_stats["tune_adjustments"] = (
+            self.last_stats.get("tune_adjustments", 0) + 1
+        )
+        self.tel.emit(
+            "tune",
+            knob=knob,
+            value=new,
+            prev=adj.get("from"),
+            reason=adj.get("reason"),
+        )
 
     def _replay_flush_faults(self, st, fl_before: int):
         """The megakernel ran its flushes in-device; fire the host
@@ -3067,6 +3179,19 @@ class DeviceChecker:
                     work_compact_elems=int(wd.get("compact_elems", 0)),
                     work_append_rows=int(wd.get("append_rows", 0)),
                 )
+                if self._tuner is not None:
+                    # online adaptation (r15): the dispatch's own
+                    # feedback — levels closed vs asked and the
+                    # running max probe depth — drives knob nudges
+                    # applied BEFORE the next dispatch (never
+                    # mid-kernel); every change is a ``tune`` event
+                    fpml = fpset.fpm_logical(self._last_fpm)
+                    for adj in self._tuner.observe(
+                        levels_closed=int(n_lv),
+                        cap_asked=int(lv_cap),
+                        max_probe_rounds=int(fpml[4]),
+                    ):
+                        self._apply_tune(adj)
                 # ---- per-level accounting replay (the kernel's
                 # lsizes): level records, log lines, and PTT_FAULT
                 # level sites fire for every batched level, in order
@@ -3160,22 +3285,9 @@ class DeviceChecker:
         """Model identity for the checkpoint signature (same contract
         as the sharded engine's): hand models carry their Constants in
         ``.c``; compiled specs are identified by module name + constant
-        bindings + lane structure."""
-        c = getattr(self.model, "c", None)
-        if c is not None:
-            return repr(c)
-        spec = getattr(self.model, "spec", None)
-        if spec is not None:
-            return repr(
-                (
-                    getattr(spec.module, "name", "?"),
-                    sorted(
-                        (k, repr(v)) for k, v in spec.constants.items()
-                    ),
-                    tuple(getattr(self.model, "lane_labels", ())),
-                )
-            )
-        return type(self.model).__name__
+        bindings + lane structure.  Shared with the tuned-profile key
+        (tune/profiles.py) so both layers agree on model identity."""
+        return tune_profiles.model_sig(self.model)
 
     def _config_sig(self) -> str:
         """Everything a frame must agree on to be resumable here: the

@@ -50,6 +50,7 @@ from jax import lax
 from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL
+from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
 from pulsar_tlaplus_tpu.utils import ckpt, faults
 
 TAG = jnp.uint32(1 << 31)
@@ -104,7 +105,8 @@ class LivenessChecker:
         max_states: int = 50_000_000,
         sweep_chunk: Optional[int] = None,
         sweep_group: Optional[int] = None,
-        compact_impl: str = "logshift",
+        compact_impl: Optional[str] = None,
+        profile=None,
         n_devices: int = 1,
         explorer_kw: Optional[dict] = None,
         max_run: int = 1 << 14,
@@ -148,6 +150,22 @@ class LivenessChecker:
         # threshold the round-5 prefetch gate used).
         if sweep_group is not None and sweep_group < 1:
             raise ValueError(f"sweep_group must be >= 1: {sweep_group}")
+        # Tuned-profile resolution (r15, tune/profiles.py): the
+        # liveness engine owns the sweep knobs; the inner explorer
+        # resolves its own device_bfs profile (``profile`` is
+        # forwarded below).  Explicit ctor knobs always win.  The key
+        # is goal-independent — sweep batching does not depend on
+        # which <>(predicate) is being checked.
+        prof = tune_profiles.resolve(
+            profile, model=model, invariants=(), engine="liveness"
+        )
+        self.profile_sig = prof["sig"] if prof else None
+        _pk = tune_profiles.knobs_for(prof, "liveness")
+        if sweep_group is None:
+            sweep_group = _pk.get("sweep_group")
+        compact_impl = (
+            compact_impl or _pk.get("compact_impl") or "logshift"
+        )
         self.sweep_group = sweep_group
         # stream-compaction impl for the sweep's edge compaction (and
         # the inner explorer's append): ops/compact.py log-shift by
@@ -191,6 +209,11 @@ class LivenessChecker:
             checkpoint_every=checkpoint_every,
             compact_impl=compact_impl,
         )
+        if n_devices <= 1:
+            # the single-chip explorer resolves its OWN tuned profile
+            # (keyed engine="device_bfs"); the sharded engine has no
+            # profile support yet
+            inner_kw.setdefault("profile", profile)
         inner_kw.update(explorer_kw or {})
         if n_devices > 1:
             from pulsar_tlaplus_tpu.engine.sharded_device import (
@@ -994,6 +1017,9 @@ class LivenessChecker:
             visited_impl=self._checker.visited_impl,
             compact_impl=self.compact_impl,
             config_sig=self._config_sig(),
+            # v8: the liveness engine's own tuned-profile attribution
+            # (the inner explorer's header carries its own)
+            profile_sig=self.profile_sig,
             wall_unix=round(time.time(), 3),
             goal=self.goal_name,
             fairness=self.fairness,

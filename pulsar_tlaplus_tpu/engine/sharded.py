@@ -515,6 +515,9 @@ class ShardedChecker:
             n_devices=self.n_shards,
             visited_impl=self.dedup_mode,
             config_sig=self._config_sig(),
+            # v8 envelope: not profile-tuned yet; the field must
+            # still exist (schema v8 run_header contract)
+            profile_sig=None,
             wall_unix=round(time.time(), 3),
             max_states=self.max_states,
             invariants=list(self.invariant_names),

@@ -1927,6 +1927,9 @@ class ShardedDeviceChecker:
             visited_impl=self.visited_impl,
             compact_impl=self.compact_impl,
             config_sig=self._config_sig(),
+            # v8 envelope: the sharded engine is not profile-tuned
+            # yet; the field must still exist (schema v8 contract)
+            profile_sig=None,
             wall_unix=round(time.time(), 3),
             max_states=self.SCAP,
             sub_batch=self.G,

@@ -164,6 +164,10 @@ def record_from_stream(events: List[dict], source: str = "") -> dict:
         values["config_sig"] = hd["config_sig"]
     if hd.get("fuse") and "fuse" not in values:
         values["fuse"] = hd["fuse"]
+    if hd.get("profile_sig"):
+        # tuned-profile attribution (r15, schema v8): lets list/
+        # compare/gate split tuned vs default trajectories
+        values["profile_sig"] = hd["profile_sig"]
     values = {
         k: v for k, v in values.items() if isinstance(v, _SCALAR)
     }
@@ -345,8 +349,32 @@ def _fmt(v) -> str:
 
 LIST_COLS = (
     "value", "distinct_states", "levels", "dispatches_per_level",
-    "work_units_per_state", "stop_reason",
+    "work_units_per_state", "stop_reason", "profile_sig",
 )
+
+
+def profile_of(rec: dict) -> Optional[str]:
+    """The tuned-profile signature a record ran under (None =
+    untuned) — the tuned-vs-default grouping key."""
+    p = (rec.get("values") or {}).get("profile_sig")
+    return str(p) if p else None
+
+
+def baseline_matches_profile(rec: dict, want: str, cur: dict) -> bool:
+    """Whether ``rec`` is an acceptable gate baseline under the
+    ``--profile`` context: ``"same"`` = identical profile context to
+    the current record (tuned gates against tuned, default against
+    default — the default policy), ``"none"`` = only untuned
+    baselines (is tuning a regression vs hand defaults?), ``"any"``
+    = no filter, anything else = a profile-sig prefix."""
+    p = profile_of(rec)
+    if want == "any":
+        return True
+    if want == "same":
+        return p == profile_of(cur)
+    if want == "none":
+        return p is None
+    return p is not None and p.startswith(want)
 
 
 def render_list(recs: List[dict], key: Optional[str] = None) -> str:

@@ -77,10 +77,17 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # sweep's ``sweep`` records carry cumulative sweep work units
 # (``sort_lanes``, ``prop_lanes``, ``compact_elems``); result stats
 # carry the ``work_*`` totals.
+# v8 (round 15, the self-tuning checker): run headers carry
+# ``profile_sig`` — the tuned profile that shaped the run's knobs
+# (null on untuned runs; the field itself is REQUIRED at v8 so the
+# ledger can always split tuned vs default trajectories) — and the
+# online-adaptation controller emits one ``tune`` record per knob
+# adjustment (knob, value, prev, reason) at the dispatch boundary
+# where it applied (tune/online.py; docs/tuning.md).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -132,10 +139,18 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("sweep", "prop_lanes"): 7,
     ("sweep", "compact_elems"): 7,
     ("attribution", "stages"): 7,
+    # v8 (round 15): tuned-profile attribution on every run header
+    # (null when no profile was active) and the online-adaptation
+    # ``tune`` record — both gated so every committed v7-and-older
+    # stream stays validator-clean.
+    ("run_header", "profile_sig"): 8,
+    ("tune", "knob"): 8,
+    ("tune", "value"): 8,
 }
 EVENTS: Dict[str, Tuple[str, ...]] = {
-    # run lifecycle
-    "run_header": ("engine", "visited_impl", "config_sig"),
+    # run lifecycle (v8 adds profile_sig — the tuned profile that
+    # shaped the run's knobs, null on untuned runs)
+    "run_header": ("engine", "visited_impl", "config_sig", "profile_sig"),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress
     "level": (
@@ -163,6 +178,10 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # a run accumulated — the machine-readable input to the calibrated
     # cost model (obs/attribution.py); one record right before result
     "attribution": ("stages",),
+    # online adaptation (r15, tune/online.py): one record per knob
+    # adjustment the dispatch-boundary controller applied — an
+    # adapted run is never silently different from its profile
+    "tune": ("knob", "value"),
     # survivability (r9: ``retries`` is the frame writer's
     # transient-failure retry count — the ckpt_retries breadcrumb)
     "ckpt_frame": (

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.service import jobs as jobmod
 from pulsar_tlaplus_tpu.service.jobs import Job
+from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
 
 
 @dataclass
@@ -60,6 +61,13 @@ class ServiceConfig:
     checkpoint_every: int = 2
     visited_impl: str = "fpset"
     compact_impl: str = "logshift"
+    # tuned-profile policy (r15, tune/profiles.py): "auto" resolves a
+    # profile per (spec, constants, invariants, backend) at checker
+    # construction — so PREWARM compiles the tuned knobs and a warm
+    # submit gets tuned executables with zero jit compiles; "none"
+    # disables lookups (serve --no-profiles).  The config knobs above
+    # are the fallback for knobs the profile does not pin.
+    profiles: str = "auto"
     specs: Tuple[str, ...] = ()  # modules to prewarm at startup
     spec_dir: str = ""  # where default <spec>.cfg files live
     prewarm_tiers: bool = True
@@ -172,15 +180,45 @@ class CheckerPool:
             ck = self._checkers.get(key)
             if ck is None:
                 cfg = self.config
+                model = self.build_model(spec, tlc_cfg)
+                # tuned-profile resolution (r15): the profile's knobs
+                # override the service-wide defaults, so prewarm
+                # compiles (and the AOT cache stores) the TUNED
+                # programs — a warm submit against this key runs the
+                # tuned executables with zero jit compiles
+                prof = None
+                if cfg.profiles != "none":
+                    prof = tune_profiles.resolve(
+                        "auto", model=model,
+                        invariants=tuple(invariants),
+                        engine="device_bfs",
+                    )
+                pk = tune_profiles.knobs_for(prof, "device_bfs")
                 ck = DeviceChecker(
-                    self.build_model(spec, tlc_cfg),
+                    model,
                     invariants=invariants,
-                    sub_batch=cfg.sub_batch,
+                    sub_batch=pk.get("sub_batch", cfg.sub_batch),
                     visited_cap=cfg.visited_cap,
                     frontier_cap=cfg.frontier_cap,
                     max_states=key[3],
                     visited_impl=cfg.visited_impl,
-                    compact_impl=cfg.compact_impl,
+                    compact_impl=pk.get(
+                        "compact_impl", cfg.compact_impl
+                    ),
+                    flush_factor=pk.get("flush_factor"),
+                    group=pk.get("group"),
+                    fuse_group=pk.get("fuse_group"),
+                    fpset_dense_rounds=pk.get("fpset_dense_rounds"),
+                    fpset_stages=pk.get("fpset_stages"),
+                    # the engine re-validates the profile against its
+                    # own config signature and records profile_sig on
+                    # every slice's run header
+                    profile=prof,
+                    # online adaptation lazily compiles re-keyed
+                    # kernels post-warm — it would break the warmed
+                    # pool's zero-compile contract, so the daemon
+                    # pins it off regardless of the profile's knob
+                    adapt=False,
                 )
                 self._checkers[key] = ck
             return key, ck

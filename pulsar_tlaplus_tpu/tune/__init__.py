@@ -1,0 +1,33 @@
+"""Self-tuning checker (round 15) — the cost-model-driven autotuner.
+
+The repo's knob space (fpset probe schedule, ``fuse_group``,
+``sub_batch``, flush factor, dispatch group-ahead, ``--sweep-group``,
+compact materialization) meets the round-14 ingredients an optimal
+mapper needs — in-kernel per-stage work counters, a calibrated ns/unit
+cost model, and a cross-run ledger — following the fusion-aware-mapper
+recipe ("The Turbo-Charged Mapper", arXiv:2602.15172; "Fast and
+Fusiest", arXiv:2602.15166): **model-predict to prune the space,
+measure only the survivors, persist the winner.**
+
+Three parts (docs/tuning.md):
+
+- **offline search** (``cli.py tune`` -> :mod:`tune.search` over
+  :mod:`tune.space` + :mod:`tune.predict`): enumerate candidate knob
+  configs, rank them with the calibrated cost model applied to
+  predicted work counts, measure the top-K with short interleaved
+  real runs, write the winner as a versioned profile;
+- **profile loading** (:mod:`tune.profiles`): engines, bench.py, and
+  the daemon's CheckerPool resolve a tuned profile by config
+  signature at construction — explicit knobs always win, and
+  ``run_header.profile_sig`` attributes every run to the profile
+  that shaped it;
+- **online adaptation** (:mod:`tune.online`): a dispatch-boundary
+  controller fed by the streaming work counters nudges the fpset
+  probe schedule and the ramp-batch cap within safe bounds — never
+  semantics, only schedules and batching (discovery order is pinned
+  state-for-state by differential tests).
+"""
+
+from pulsar_tlaplus_tpu.tune import online, predict, profiles, space
+
+__all__ = ["online", "predict", "profiles", "space"]

@@ -1,0 +1,131 @@
+"""The declared knob space the offline tuner searches.
+
+Each knob names an engine constructor parameter, its candidate values,
+and the validity constraints that prune impossible combinations (the
+engine would reject them anyway — pruning here keeps the predict stage
+honest about how many candidates were actually considered).  The space
+is deliberately small and discrete: the cost model ranks the whole
+cartesian product in microseconds, and only the top-K survivors ever
+touch the device (docs/tuning.md).
+
+Knob semantics (all scheduling/batching — NONE may change discovery
+order; pinned by the differential tests in tests/test_tune.py):
+
+- ``sub_batch``       frontier states per expand window (G)
+- ``flush_factor``    accumulator windows merged per fpset flush
+- ``group``           dispatch group-ahead between stats fetches
+                      (growth headroom follows it: (group+1) * ACAP)
+- ``fuse_group``      max ramp levels one fused dispatch may close
+- ``fpset_dense_rounds``  full-width probe rounds before the staged
+                      pending-compaction shrinks the batch
+- ``compact_impl``    stream-compaction materialization (logshift|sort)
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    values: Tuple
+    doc: str
+
+
+# the device-engine search space.  Values are multipliers-of-default
+# where the default is shape-dependent (sub_batch) and absolute
+# elsewhere; ``None`` means "engine default / auto".
+DEVICE_KNOBS: Tuple[Knob, ...] = (
+    Knob(
+        "sub_batch", (None, 0.25, 0.5, 2.0),
+        "expand window G (x default)",
+    ),
+    Knob("flush_factor", (None, 2, 3), "acc windows per flush"),
+    Knob("group", (None, 2, 8), "dispatch group-ahead"),
+    Knob("fuse_group", (None, 1, 4, 16), "ramp levels per dispatch"),
+    Knob("fpset_dense_rounds", (None, 2, 8), "dense probe rounds"),
+    # compact_impl is deliberately NOT searched: the ledger's config
+    # key folds it in (a sort-impl run is a different comparability
+    # class, kept for differential timing), so a profile that tuned
+    # it could never gate against the hand-default baseline — the
+    # headline "tuning never regresses" check would be structurally
+    # impossible.  It remains a loadable profile knob for manual
+    # profiles (PROFILE_KNOBS below).
+)
+
+# liveness-engine knobs carried by profiles (loaded by
+# LivenessChecker; offline search over them is future work — the
+# device engine dominates exploration wall)
+LIVENESS_KNOBS: Tuple[Knob, ...] = (
+    Knob("sweep_group", (None, 2, 8, 32), "sweep chunks per dispatch"),
+)
+
+# every knob name a profile may carry, per engine — the profile
+# validator and the engine-side resolver both consult this table
+PROFILE_KNOBS: Dict[str, Tuple[str, ...]] = {
+    "device_bfs": (
+        "sub_batch", "flush_factor", "group", "fuse_group",
+        "fpset_dense_rounds", "fpset_stages", "compact_impl", "adapt",
+    ),
+    "liveness": ("sweep_group", "compact_impl", "adapt"),
+}
+
+
+def _valid(model, cand: Dict, base_sub_batch: int) -> bool:
+    """The engine's own constructor constraints, pre-checked so the
+    predict stage never ranks a config the engine would reject."""
+    g = cand.get("sub_batch") or base_sub_batch
+    ff = cand.get("flush_factor") or 1
+    a, w = int(model.A), int(model.layout.W)
+    if g < 64:
+        return False
+    # flat accumulator addressing: sub_batch * A * flush_factor * W
+    # must stay below 2^31 (device_bfs.__init__)
+    if g * a * ff * w >= 1 << 31:
+        return False
+    return True
+
+
+def candidates(
+    model,
+    base_sub_batch: int = 8192,
+    knobs: Iterable[Knob] = DEVICE_KNOBS,
+    limit: Optional[int] = None,
+) -> List[Dict]:
+    """The cartesian product of the knob space, validity-pruned, as a
+    list of sparse knob dicts (``None`` entries — engine defaults —
+    are dropped; the all-default candidate comes first and IS the
+    baseline the tuner must beat).  ``sub_batch`` multipliers resolve
+    against ``base_sub_batch`` rounded to a power of two."""
+    knobs = tuple(knobs)
+    out: List[Dict] = []
+    for combo in itertools.product(*(k.values for k in knobs)):
+        cand: Dict = {}
+        for k, v in zip(knobs, combo):
+            if v is None:
+                continue
+            if k.name == "sub_batch":
+                g = int(base_sub_batch * v)
+                # power-of-two windows keep expand_chunk divisibility
+                p = 1
+                while p * 2 <= g:
+                    p *= 2
+                cand[k.name] = max(p, 64)
+            else:
+                cand[k.name] = v
+        if not _valid(model, cand, base_sub_batch):
+            continue
+        out.append(cand)
+        if limit is not None and len(out) >= limit:
+            break
+    return out
+
+
+def describe(cand: Dict) -> str:
+    """One-line render of a sparse candidate ("defaults" when empty)."""
+    if not cand:
+        return "defaults"
+    return ",".join(f"{k}={v}" for k, v in sorted(cand.items()))

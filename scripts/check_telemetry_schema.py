@@ -36,11 +36,17 @@ carry strictly increasing boundary ``level`` records whose per-level
 sizes match the result's ``level_sizes`` and, on clean runs, sum to
 its distinct-state count; r14: v7 ``fuse`` records carry per-dispatch
 work-unit deltas, ``sweep`` records cumulative sweep work units, and
-the new ``attribution`` record the per-stage work totals — all
-FIELD_SINCE-gated so older streams stay clean).  ``--trace``
+the new ``attribution`` record the per-stage work totals; r15: v8
+run headers carry ``profile_sig`` — the tuned profile that shaped
+the run's knobs, null on untuned runs — and the online-adaptation
+controller emits ``tune`` records (knob, value) at the dispatch
+boundaries where adjustments applied — all FIELD_SINCE-gated so
+older streams stay clean).  ``--trace``
 validates an exported Perfetto trace file's event structure instead
 (obs/trace.py); ``--ledger`` validates cross-run regression ledger
-files (obs/ledger.py — record structure + digest integrity).  Bench
+files (obs/ledger.py — record structure + digest integrity);
+``--profile`` validates tuned-profile JSON files (tune/profiles.py —
+format version, engine-known knobs, filename/sig agreement).  Bench
 rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
@@ -316,6 +322,12 @@ def main(argv=None) -> int:
         "(cli.py ledger output) and validate their record structure "
         "+ digest integrity instead of the telemetry stream schema",
     )
+    ap.add_argument(
+        "--profile", action="store_true",
+        help="treat the .json files as tuned-profile files (cli.py "
+        "tune output) and validate their structure against the "
+        "profile schema (tune/profiles.py)",
+    )
     args = ap.parse_args(argv)
     files = list(args.files)
     if args.all_bench:
@@ -340,6 +352,10 @@ def main(argv=None) -> int:
             from pulsar_tlaplus_tpu.obs.trace import validate_trace
 
             errors += validate_trace(p)
+        elif args.profile:
+            from pulsar_tlaplus_tpu.tune.profiles import validate_file
+
+            errors += validate_file(p)
         else:
             errors += validate_bench_artifact(p)
     for e in errors:

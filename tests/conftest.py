@@ -6,8 +6,17 @@ Must run before jax is imported anywhere.
 """
 
 import os
+import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Tuned-profile hermeticity (r15): CLI/daemon paths resolve profiles
+# from PTT_TUNE_DIR (default ~/.ptt_profiles) — a stray profile on the
+# developer's machine must never reshape pinned test geometry, and
+# adaptation must never default on mid-suite.  Set unconditionally
+# (not setdefault): subprocess-driven CLI tests inherit this env.
+os.environ["PTT_TUNE_DIR"] = tempfile.mkdtemp(prefix="ptt_test_profiles_")
+os.environ.pop("PTT_TUNE_ADAPT", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -146,6 +146,64 @@ def test_liveness_termination():
     assert not r2.holds
 
 
+# ---- pinned oracle counts (r15, scenario diversity) -----------------
+# Georeplication becomes the THIRD exact-parity pinned workload beside
+# compaction (45,198 / 253,361) and bookkeeper (297 / 2,257): the
+# shipped binding (specs/georeplication.cfg — 3 clusters, 1 msg, 1
+# crash) pins 6,400 states / diameter 18 on the interpreter AND the
+# device engine, making it a tuning target and a daemon registry
+# workload with a ground truth.  Derived from the interpreter BFS on
+# specs/georeplication.tla; the smaller two_clusters binding (460 /
+# 14) re-derives inline as the cheap cross-check.
+
+SHIPPED_STATES, SHIPPED_DIAMETER = 6400, 18   # specs/georeplication.cfg
+TWO_CLUSTERS_STATES, TWO_CLUSTERS_DIAMETER = 460, 14
+
+
+def test_shipped_cfg_pinned_oracle_count(module):
+    """Interpreter, host engine, and device engine all reproduce the
+    pinned shipped-binding count — the exact-parity contract the
+    other two registry workloads already carry."""
+    c = CONFIGS["shipped"]
+    ri = InterpChecker(spec_for(module, c)).run()
+    assert (ri.distinct_states, ri.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    rh = Checker(GeoreplicationModel(c), frontier_chunk=512).run()
+    assert (rh.distinct_states, rh.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    rd = DeviceChecker(
+        GeoreplicationModel(c), sub_batch=512, visited_cap=1 << 13,
+        frontier_cap=1 << 11,
+    ).run()
+    assert (rd.distinct_states, rd.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    assert rd.violation is None and not rd.deadlock
+
+
+def test_two_clusters_pinned_oracle_count(module):
+    """The cheap binding's pinned count (re-derived on the
+    interpreter + pinned on the device engine)."""
+    c = CONFIGS["two_clusters"]
+    ri = InterpChecker(spec_for(module, c)).run()
+    assert (ri.distinct_states, ri.diameter) == (
+        TWO_CLUSTERS_STATES, TWO_CLUSTERS_DIAMETER,
+    )
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    rd = DeviceChecker(
+        GeoreplicationModel(c), sub_batch=256, visited_cap=1 << 11,
+        frontier_cap=1 << 9,
+    ).run()
+    assert (rd.distinct_states, rd.diameter) == (
+        TWO_CLUSTERS_STATES, TWO_CLUSTERS_DIAMETER,
+    )
+
+
 def test_simulation_finds_duplicate():
     from pulsar_tlaplus_tpu.engine.simulate import Simulator
 
