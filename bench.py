@@ -357,6 +357,20 @@ def parse_args(argv=None):
         "telemetry must not leak one file per bench run forever",
     )
     ap.add_argument(
+        "--hbm-budget", dest="hbm_budget", default=None,
+        metavar="BYTES",
+        help="device-memory byte budget for the tiered state store "
+        "(e.g. 7.5G; PTT_HBM_BUDGET works too): visited keys and "
+        "aged rows/logs spill to host tiers past it — the artifact "
+        "then carries spill_bytes_per_state/spill_overlap_ratio "
+        "(docs/memory.md)",
+    )
+    ap.add_argument(
+        "--no-spill-compress", dest="no_spill_compress",
+        action="store_true",
+        help="spill raw planes instead of delta+zlib",
+    )
+    ap.add_argument(
         "--progress-every", type=float, default=None, metavar="SEC",
         help="TLC-style heartbeat line every SEC seconds from the "
         "last fetched stats snapshot (zero extra device syncs)",
@@ -431,6 +445,8 @@ def main(argv=None):
     if args.profile != "none":
         from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
 
+        from pulsar_tlaplus_tpu.store import budget as store_budget
+
         prof = tune_profiles.resolve(
             "auto" if args.profile == "auto" else args.profile,
             model=model,
@@ -438,6 +454,11 @@ def main(argv=None):
                 getattr(model, "default_invariants", ())
             ),
             engine="device_bfs",
+            # the tiered REGIME is part of the profile key (r16): a
+            # budgeted bench must resolve the spill-tuned profile,
+            # never the all-resident one — env var included
+            tiered=store_budget.resolve_budget(args.hbm_budget)
+            is not None,
         )
     if prof:
         pk = tune_profiles.knobs_for(prof, "device_bfs")
@@ -463,6 +484,13 @@ def main(argv=None):
             xprof_window = parse_level_window(args.xprof_levels)
         except ValueError as e:
             sys.exit(f"bench: --xprof-levels: {e}")
+    # explicit flag wins; else the tuned profile's knob — popped
+    # UNCONDITIONALLY so the **kw pass-through can never duplicate
+    # the ctor kwarg
+    prof_spill_compress = kw.pop("spill_compress", None)
+    spill_compress = (
+        False if args.no_spill_compress else prof_spill_compress
+    )
     ck = DeviceChecker(
         model,
         time_budget_s=args.budget_s,
@@ -472,6 +500,8 @@ def main(argv=None):
         compact_impl=kw.pop("compact_impl", args.compact),
         fuse=args.fuse,
         fuse_group=kw.pop("fuse_group", args.fuse_group),
+        hbm_budget=args.hbm_budget,
+        spill_compress=spill_compress,
         profile=prof,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
@@ -629,8 +659,11 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # in-kernel work-unit totals (work_*) the
                 # cost-attribution model prices and the ledger gates
                 # (work-units/state is the machine-independent
-                # efficiency signal)
-                "bench_schema": 7,
+                # efficiency signal); schema 8 (r16) adds the
+                # tiered-store budget + spill economy keys
+                # (hbm_budget, spill_bytes_per_state,
+                # spill_overlap_ratio — null on untiered runs)
+                "bench_schema": 8,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2
@@ -716,6 +749,20 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "work_compact_elems": stat("work_compact_elems"),
                 "work_append_rows": stat("work_append_rows"),
                 "work_groups": stat("work_groups"),
+                # tiered-store economy (r16, bench_schema 8): the
+                # budget the run was tiered under (null = untiered),
+                # compressed spill bytes per distinct state (the
+                # 1B-state byte-rate arithmetic's measured input),
+                # and the async-transfer overlap ratio (1.0 = level
+                # boundaries never waited on a spill transfer)
+                "hbm_budget": stat("hbm_budget"),
+                "spill_bytes_per_state": stat("spill_bytes_per_state"),
+                "spill_overlap_ratio": stat("spill_overlap_ratio"),
+                "spill_bytes_raw": stat("spill_bytes_raw"),
+                "spill_bytes_comp": stat("spill_bytes_comp"),
+                "spill_keys_evicted": stat("spill_keys_evicted"),
+                "spill_rows_evicted": stat("spill_rows_evicted"),
+                "spill_misses_resolved": stat("spill_misses_resolved"),
                 # per-stage dispatch counts straight from the stream
                 # (the telemetry_report --bench-keys layer; None when
                 # --no-telemetry)

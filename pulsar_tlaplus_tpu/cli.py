@@ -161,6 +161,8 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         compact_impl=_tunable(args, "compact", args.compact),
         fuse=args.fuse,
         fuse_group=args.fuse_group,
+        hbm_budget=args.hbm_budget,
+        spill_compress=(False if args.no_spill_compress else None),
         profile=_profile_arg(args),
         adapt=_adapt_arg(args),
         telemetry=args.telemetry,
@@ -303,6 +305,8 @@ def _check_properties(args, model, properties, rc):
                     # states location per invocation)
                     checkpoint_path=args.checkpoint,
                     sweep_group=args.sweep_group,
+                    hbm_budget=args.hbm_budget,
+                    spill_compress=(False if args.no_spill_compress else None),
                     compact_impl=_tunable(args, "compact", args.compact),
                     profile=_profile_arg(args),
                     telemetry=args.telemetry,
@@ -403,6 +407,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
                 max_states=args.maxstates,
                 checkpoint_path=args.checkpoint,
                 sweep_group=args.sweep_group,
+                hbm_budget=args.hbm_budget,
+                spill_compress=(False if args.no_spill_compress else None),
                 compact_impl=_tunable(args, "compact", args.compact),
                 profile=_profile_arg(args),
                 telemetry=args.telemetry,
@@ -514,6 +520,8 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             compact_impl=_tunable(args, "compact", args.compact),
             fuse=args.fuse,
             fuse_group=args.fuse_group,
+            hbm_budget=args.hbm_budget,
+            spill_compress=(False if args.no_spill_compress else None),
             profile=_profile_arg(args),
             adapt=_adapt_arg(args),
             checkpoint_path=args.checkpoint,
@@ -1091,6 +1099,11 @@ def _cmd_tune(args) -> int:
                 visited_cap=args.visited_cap,
                 frontier_cap=args.frontier_cap,
                 max_states=args.maxstates,
+                **(
+                    {"hbm_budget": args.hbm_budget}
+                    if args.hbm_budget
+                    else {}
+                ),
             ),
             budget_s=args.budget,
             top_k=args.top_k,
@@ -1427,6 +1440,15 @@ def main(argv=None):
         help="optional per-run time budget",
     )
     ptn.add_argument(
+        "--hbm-budget",
+        dest="hbm_budget",
+        default=None,
+        metavar="BYTES",
+        help="tune the workload under a tiered-store byte budget "
+        "(adds the spill knobs — headroom, compression, miss batch — "
+        "to the searched space; docs/memory.md)",
+    )
+    ptn.add_argument(
         "--visited-cap", type=int, default=1 << 16,
         help="initial visited-set tier for the measured runs",
     )
@@ -1675,6 +1697,25 @@ def main(argv=None):
         help="checkpoint file (.npz): level-boundary frames are written "
         "atomically every few levels; SIGTERM/SIGINT checkpoint at the "
         "next boundary and exit resumably; resume with -recover",
+    )
+    pc.add_argument(
+        "-hbm-budget",
+        dest="hbm_budget",
+        metavar="BYTES",
+        default=None,
+        help="device-memory byte budget for the tiered state store "
+        "(e.g. 7.5G, 512M; PTT_HBM_BUDGET env works too): visited "
+        "keys and aged rows/trace logs past the budget spill to host "
+        "RAM (and, with -checkpoint, to disk) through the "
+        "sieve-and-compress pipeline — breaks the HBM ceiling on "
+        "max_states (docs/memory.md)",
+    )
+    pc.add_argument(
+        "-no-spill-compress",
+        dest="no_spill_compress",
+        action="store_true",
+        help="spill raw planes instead of delta+zlib (trades link "
+        "bytes for encode CPU; docs/memory.md)",
     )
     pc.add_argument(
         "-recover", action="store_true", help="resume from -checkpoint"

@@ -380,6 +380,7 @@ class Checker:
             # but the field must exist so the ledger can split tuned
             # vs default trajectories uniformly
             profile_sig=None,
+            hbm_budget=None,
             wall_unix=round(time.time(), 3),
             max_states=self.max_states,
             invariants=list(self.invariant_names),

@@ -79,6 +79,9 @@ from jax import lax
 
 from pulsar_tlaplus_tpu.engine.bfs import CheckerResult
 from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.store import budget as store_budget
+from pulsar_tlaplus_tpu.store import sieve as store_sieve
+from pulsar_tlaplus_tpu.store.tiers import TieredStore
 from pulsar_tlaplus_tpu.tune import online as tune_online
 from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
 from pulsar_tlaplus_tpu.utils import ckpt, device, faults, recovery
@@ -156,6 +159,11 @@ class DeviceChecker:
         fuse_group: Optional[int] = None,
         fpset_dense_rounds: Optional[int] = None,
         fpset_stages=None,
+        hbm_budget=None,
+        hbm_headroom: Optional[float] = None,
+        spill_dir: Optional[str] = None,
+        spill_compress: Optional[bool] = None,
+        miss_batch: Optional[int] = None,
         profile=None,
         adapt: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
@@ -200,9 +208,14 @@ class DeviceChecker:
         # a path, or a profile dict; resolution failures warn and fall
         # back — a tuned profile is an optimization, never a
         # correctness dependency.
+        # the budget resolves BEFORE the profile: the tiered regime is
+        # part of the profile key (a spill-tuned winner must never
+        # auto-resolve for an all-resident run, or vice versa)
+        self.hbm_budget = store_budget.resolve_budget(hbm_budget)
+        self.tiered = self.hbm_budget is not None
         prof = tune_profiles.resolve(
             profile, model=model, invariants=self.invariant_names,
-            engine="device_bfs",
+            engine="device_bfs", tiered=self.tiered,
         )
         self.profile_sig = prof["sig"] if prof else None
         _pk = tune_profiles.knobs_for(prof, "device_bfs")
@@ -218,9 +231,20 @@ class DeviceChecker:
                     "fpset_dense_rounds": fpset_dense_rounds,
                     "fpset_stages": fpset_stages,
                     "compact_impl": compact_impl,
+                    "hbm_headroom": hbm_headroom,
+                    "spill_compress": spill_compress,
+                    "miss_batch": miss_batch,
                 }.get(k) is None
             )
         )
+        # tiered-store knobs resolve like every other profile knob:
+        # explicit ctor value > tuned profile > engine default
+        if hbm_headroom is None:
+            hbm_headroom = _pk.get("hbm_headroom")
+        if spill_compress is None:
+            spill_compress = _pk.get("spill_compress")
+        if miss_batch is None:
+            miss_batch = _pk.get("miss_batch")
         sub_batch = sub_batch or _pk.get("sub_batch") or 8192
         group = group or _pk.get("group") or 4
         flush_factor = flush_factor or _pk.get("flush_factor") or 1
@@ -404,8 +428,105 @@ class DeviceChecker:
         # the seed loader's blind DUS window must fit small frontier
         # windows too (bench-scale APAD dwarfs it, so no change there)
         self.SEED_CHUNK = min(DeviceChecker.SEED_CHUNK, self.APAD)
+        # ---- tiered state store (round 16, store/): a byte budget for
+        # everything device-resident.  Growth sites consult the budget
+        # instead of truncating: the fpset table stops doubling at the
+        # budget-derived tier and evicts cold generations to the host
+        # store; the row/log stores become sliding windows whose aged
+        # ranges spill at level boundaries.  docs/memory.md.
+        self.hbm_headroom = float(
+            hbm_headroom if hbm_headroom is not None else 0.1
+        )
+        if not (0.0 <= self.hbm_headroom < 1.0):
+            raise ValueError(
+                f"hbm_headroom must be in [0, 1): {self.hbm_headroom}"
+            )
+        self.spill_compress = (
+            True if spill_compress is None else bool(spill_compress)
+        )
+        self.miss_batch = int(miss_batch or (1 << 15))
+        if self.miss_batch < 1:
+            raise ValueError(f"miss_batch must be >= 1: {self.miss_batch}")
+        self._spill_dir_arg = spill_dir
+        self.tstore: Optional[TieredStore] = None
+        # log-shift chunk (tiered log windows slide like the rows)
+        self.LOG_CW = min(1 << 22, self.APAD)
+        if self.tiered:
+            if self.visited_impl != "fpset":
+                raise ValueError(
+                    "the tiered store needs the fpset visited set "
+                    "(hbm_budget with visited_impl='sort' is "
+                    "unsupported)"
+                )
+            if self.rows_window != "all":
+                raise ValueError(
+                    "hbm_budget and rows_window='frontier' are "
+                    "mutually exclusive — the tiered store IS the "
+                    "row-window story (aged rows spill instead of "
+                    "dropping)"
+                )
+            # budget-derived tier ceilings: round-robin doubling from
+            # the initial tiers while the worst-case resident bytes
+            # stay inside the effective budget — deterministic, so
+            # prewarm walks exactly the reachable (capped) staircase
+            eff = int(self.hbm_budget * (1.0 - self.hbm_headroom))
+            capv_abs = max(self.SCAP + self.ACAP, self.ACAP * 2)
+            capl_abs = max(
+                self.SCAP + self.APAD, self.NCs + self.APAD
+            )
+            tc, lc, pc = self.TCAP, self.LCAP, self.PCAP
+            if self._device_bytes_est(tc, lc, pc) > eff:
+                raise ValueError(
+                    "hbm_budget too small: the initial tiers need "
+                    f"{store_budget.fmt_bytes(self._device_bytes_est(tc, lc, pc))}"
+                    f" (+{self.hbm_headroom:.0%} headroom) but the "
+                    f"budget is {store_budget.fmt_bytes(self.hbm_budget)}"
+                    " — raise the budget or shrink sub_batch/"
+                    "visited_cap"
+                )
+            while True:
+                grew = False
+                if (
+                    tc // 2 < capv_abs
+                    and self._device_bytes_est(tc * 2, lc, pc) <= eff
+                ):
+                    tc *= 2
+                    grew = True
+                nl = self._next_cap(lc, lc + 1, capl_abs)
+                if nl > lc and self._device_bytes_est(tc, nl, pc) <= eff:
+                    lc = nl
+                    grew = True
+                npc = self._next_cap(pc, pc + 1, capl_abs)
+                if npc > pc and self._device_bytes_est(tc, lc, npc) <= eff:
+                    pc = npc
+                    grew = True
+                if not grew:
+                    break
+            # structural floor: the run loop's in-flight contract
+            # needs the hot table to absorb at least two accumulators
+            # past any hot count eviction can reach — a budget below
+            # that tier is honored as closely as possible, never
+            # exactly (the viability check above catches gross cases)
+            while tc // 2 < 2 * self.ACAP:
+                tc *= 2
+            self._tcap_max, self._lcap_max, self._pcap_max = tc, lc, pc
+            # clamp the dispatch group-ahead so a full group of
+            # in-flight flushes fits the BUDGETED table: otherwise
+            # every growth site would be forced past the budget and
+            # the hot tier could never stay small (the whole point)
+            group = max(
+                1, min(group, tc // 2 // self.ACAP - 1)
+            )
+        # per-run spill state (reset in run())
+        self._spill_active = False
+        self._epoch = 1
+        self._hot_n = 0
+        self._spill_sync_n = 0
+        self._spill_emit_mark = 0
+        self._budget_overridden = False
         max_rows = (
             self.LCAP if rows_window == "frontier"
+            else self._lcap_max if self.tiered
             else max(max_states, self.NCs) + self.APAD
         )
         if max_rows * self.W >= 1 << 31:
@@ -495,6 +616,43 @@ class DeviceChecker:
         while n < c:
             n <<= 1
         return n
+
+    # ----------------------------------------------- tiered-store sizing
+
+    def _device_bytes_est(self, tcap: int, lcap: int, pcap: int) -> int:
+        """Worst-case resident bytes at a (TCAP, LCAP, PCAP) tier
+        triple: the fpset key columns + generation column, the padded
+        row/log windows, and the fixed accumulator buffers.  This is
+        what the budget caps — the arithmetic behind every
+        grow-or-spill decision (docs/memory.md)."""
+        fixed = (self.K + self.W) * self.ACAP * 4
+        table = (tcap + 1) * (self.K + 1) * 4
+        rows = (lcap * self.W + self.SHIFT_CW) * 4
+        logs = 2 * (pcap + self.LOG_CW) * 4
+        return fixed + table + rows + logs
+
+    def _capv(self) -> int:
+        """Max states the visited tier may ever admit: the run-
+        reachable formula, budget-clamped in tiered mode (the capacity
+        guard consults the tier budget instead of truncating)."""
+        cap = max(self.SCAP + self.ACAP, self.ACAP * 2)
+        if self.tiered:
+            cap = min(cap, self._tcap_max // 2)
+        return cap
+
+    def _capl(self) -> int:
+        """Max row-store states (budget-clamped window in tiered mode)."""
+        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        if self.tiered:
+            cap = min(cap, self._lcap_max)
+        return cap
+
+    def _capp(self) -> int:
+        """Max trace-log states (budget-clamped window in tiered mode)."""
+        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        if self.tiered:
+            cap = min(cap, self._pcap_max)
+        return cap
 
     def _log(self, msg: str):
         if self.progress:
@@ -884,10 +1042,11 @@ class DeviceChecker:
 
         def step(rows_store, parent_log, lane_log, crows, idx,
                  n_new, n_visited, viol, acc_base, is_init, row_base,
-                 rows_ok):
+                 rows_ok, log_base):
             return self._append_body(
                 rows_store, parent_log, lane_log, crows, idx, n_new,
                 n_visited, viol, acc_base, is_init, row_base, rows_ok,
+                log_base,
             )
 
         fn = ajit(step, donate_argnums=(0, 1, 2))
@@ -896,7 +1055,7 @@ class DeviceChecker:
 
     def _append_body(self, rows_store, parent_log, lane_log, crows,
                      idx, n_new, n_visited, viol, acc_base, is_init,
-                     row_base, rows_ok):
+                     row_base, rows_ok, log_base=jnp.int32(0)):
         """Traced append sub-function (shared by ``_append_jit`` and
         the fused level megakernel) — see :meth:`_append_jit` for the
         full contract."""
@@ -976,10 +1135,10 @@ class DeviceChecker:
             0, n_chunks, chunk, (viol, rows_store)
         )
         parent_log = lax.dynamic_update_slice(
-            parent_log, par, (n_visited,)
+            parent_log, par, (n_visited - log_base,)
         )
         lane_log = lax.dynamic_update_slice(
-            lane_log, lane, (n_visited,)
+            lane_log, lane, (n_visited - log_base,)
         )
         return (
             rows_store, parent_log, lane_log, n_visited + n_new,
@@ -1229,6 +1388,137 @@ class DeviceChecker:
         self._jits[key] = fn
         return fn
 
+    # ------------------------------------ tiered-store device ops (r16)
+
+    def _logshift_jit(self):
+        """Tiered mode: slide the live tail of the parent/lane trace
+        logs down after an aged range spilled — ``(parent, lane,
+        src_off, n)``, the :meth:`_shift_jit` contract for the two
+        int32 log planes (``LOG_CW`` tail padding gives the same
+        clamp-safety)."""
+        key = ("logshift", self.PCAP)
+        if key in self._jits:
+            return self._jits[key]
+        CW = self.LOG_CW
+
+        def step(parent, lane, src_off, n):
+            def body(i, st):
+                p, ln = st
+                cp = lax.dynamic_slice(p, (src_off + i * CW,), (CW,))
+                cl = lax.dynamic_slice(ln, (src_off + i * CW,), (CW,))
+                return (
+                    lax.dynamic_update_slice(p, cp, (i * CW,)),
+                    lax.dynamic_update_slice(ln, cl, (i * CW,)),
+                )
+
+            return lax.fori_loop(
+                0, (n + CW - 1) // CW, body, (parent, lane)
+            )
+
+        fn = ajit(step, donate_argnums=(0, 1))
+        self._jits[key] = fn
+        return fn
+
+    def _tag_jit(self):
+        """``(vk cols, gen, epoch) -> gen'`` — stamp occupied-but-
+        untagged fpset slots with the current eviction epoch (one
+        masked pass per level boundary; store/sieve.py)."""
+        key = ("spill_tag", self.TCAP)
+        if key in self._jits:
+            return self._jits[key]
+        K = self.K
+
+        def step(*args):
+            return store_sieve.tag_generation(
+                args[:K], args[K], args[K + 1]
+            )
+
+        fn = ajit(step, donate_argnums=(self.K,))
+        self._jits[key] = fn
+        return fn
+
+    def _evict_jit(self):
+        """``(vk cols, gen, cutoff) -> (vk holed, gen', ev sorted
+        cols, n_evicted)`` — extract generations at or below the
+        cutoff, sorted for the host's delta codec.  The holed table
+        must be rehashed (:meth:`_rehash_same_jit`) before it serves
+        lookups again."""
+        key = ("spill_evict", self.TCAP, self.compact_impl)
+        if key in self._jits:
+            return self._jits[key]
+        K = self.K
+        impl = self.compact_impl
+
+        def step(*args):
+            holed, gen, ev, n = store_sieve.extract_cold(
+                args[:K], args[K], args[K + 1], compact_impl=impl
+            )
+            return (*holed, gen, *ev, n)
+
+        fn = ajit(step, donate_argnums=tuple(range(self.K + 1)))
+        self._jits[key] = fn
+        return fn
+
+    def _rehash_same_jit(self):
+        """Rebuild a holed (post-eviction) table at the SAME capacity
+        — open-addressing probe chains break across holes, so the
+        survivors re-insert into a fresh table.  No donation: XLA may
+        not alias the input (rehash reads old slots while writing new
+        ones)."""
+        key = ("spill_rehash", self.TCAP)
+        if key in self._jits:
+            return self._jits[key]
+        K, TCAP = self.K, self.TCAP
+
+        def step(*old):
+            new, failed = fpset.rehash_cols(
+                old, fpset.empty_cols(TCAP, K)
+            )
+            return (*new, failed)
+
+        fn = ajit(step)
+        self._jits[key] = fn
+        return fn
+
+    def _sieve_jit(self):
+        """``(ak cols, flag_acc) -> (kcols dense, lane_ids, n_new)``
+        — pack exactly the hot-filter survivors for cold-tier miss
+        resolution; only these keys ever cross the link (the sieve)."""
+        key = ("spill_sieve", self.compact_impl)
+        if key in self._jits:
+            return self._jits[key]
+        K = self.K
+        impl = self.compact_impl
+
+        def step(*args):
+            return store_sieve.sieve_new(
+                args[:K], args[K], compact_impl=impl
+            )
+
+        fn = ajit(step)
+        self._jits[key] = fn
+        return fn
+
+    # width of one unflag scatter (false-new lanes per dispatch); a
+    # flush with more cold duplicates chunks the merge host-side
+    UNFLAG_P = 1 << 10
+
+    def _unflag_jit(self):
+        """``(flag_acc, lanes[UNFLAG_P], n) -> flag_acc'`` — merge the
+        cold-tier verdicts back: lanes resolved already-visited stop
+        being new BEFORE the compaction that assigns gids (the tiered
+        discovery-order exactness hinge; store/sieve.py)."""
+        key = ("spill_unflag",)
+        if key in self._jits:
+            return self._jits[key]
+
+        def step(flag_acc, lanes, n):
+            return store_sieve.unflag_lanes(flag_acc, lanes, n)
+
+        fn = ajit(step, donate_argnums=(0,))
+        self._jits[key] = fn
+        return fn
+
     def _stats_jit(self):
         key = ("stats", self.visited_impl)
         if key in self._jits:
@@ -1467,6 +1757,24 @@ class DeviceChecker:
                 f"seed ({n} states) exceeds the frontier rows window "
                 f"({self.LCAP}); raise row_cap_states"
             )
+        if self.tiered and (
+            n + self.SEED_CHUNK > min(self._capl(), self._capp())
+            or n + self.ACAP > self._capv()
+        ):
+            # seeds load before any spill boundary exists: honor them
+            # past the budget (warned once), like the init valve —
+            # table ceiling included (the seed merge inserts every
+            # seed key hot before any eviction can run)
+            self._lcap_max = max(self._lcap_max, n + self.SEED_CHUNK)
+            self._pcap_max = max(self._pcap_max, n + self.SEED_CHUNK)
+            while self._tcap_max // 2 < n + self.ACAP:
+                self._tcap_max *= 2
+            if not self._budget_overridden:
+                self._budget_overridden = True
+                self._log(
+                    "WARNING: hbm_budget too small for the seed — "
+                    "growing past the budget"
+                )
         if (
             self.rows_window == "frontier"
             and lsizes
@@ -1595,7 +1903,7 @@ class DeviceChecker:
     # ------------------------------------------------------------ growth
 
     def _grow_visited(self, bufs, need: int):
-        cap = max(self.SCAP + self.ACAP, self.ACAP * 2)
+        cap = self._capv()
         # clamp at the most any run can use: nv never exceeds SCAP, so
         # a table/column set admitting SCAP + one accumulator suffices
         # — and the clamp makes the tier schedule DETERMINISTIC, which
@@ -1606,7 +1914,11 @@ class DeviceChecker:
             # double + on-device rehash, capped at the most any run can
             # use (nv never exceeds SCAP, so a table admitting
             # SCAP + ACAP states at load 1/2 never needs to grow again
-            # even when the caller's headroom ask overshoots it)
+            # even when the caller's headroom ask overshoots it).  In
+            # tiered mode the cap is additionally budget-clamped — a
+            # need past it is served by EVICTION, not growth
+            # (_ensure_hot_capacity).
+            grew = False
             while self.VCAP < need and self.VCAP < cap:
                 out = self._rehash_jit()(*bufs["vk"])
                 bufs["vk"], failed = out[: self.K], out[self.K]
@@ -1617,6 +1929,19 @@ class DeviceChecker:
                     )
                 self.TCAP *= 2
                 self.VCAP = self.TCAP // 2
+                grew = True
+            if grew and self.tiered and "gen" in bufs:
+                # the rehash scattered every key to a fresh slot, so
+                # per-slot ages are void: restart the epoch clock with
+                # all survivors at the base generation (a documented
+                # coarsening — eviction order resets, membership and
+                # discovery order are untouched)
+                bufs["gen"] = self._tag_jit()(
+                    *bufs["vk"],
+                    jnp.zeros((self.TCAP + 1,), jnp.int32),
+                    jnp.int32(1),
+                )
+                self._epoch = 2
             return
         while self.VCAP < need:
             pad = min(self.VCAP, max(cap - self.VCAP, need - self.VCAP))
@@ -1629,10 +1954,21 @@ class DeviceChecker:
             self.VCAP += pad
 
     def _rows_len(self) -> int:
-        """Rows buffer length in words (frontier mode pads by SHIFT_CW
-        so the shift's ceil-rounded last chunk read can never clamp)."""
-        pad = self.SHIFT_CW if self.rows_window == "frontier" else 0
+        """Rows buffer length in words (frontier AND tiered modes pad
+        by SHIFT_CW so the sliding-window shift's ceil-rounded last
+        chunk read can never clamp)."""
+        pad = (
+            self.SHIFT_CW
+            if self.rows_window == "frontier" or self.tiered
+            else 0
+        )
         return self.LCAP * self.W + pad
+
+    def _logs_len(self) -> int:
+        """Trace-log buffer length (tiered mode pads by LOG_CW — the
+        log window slides down after an aged range spills, with the
+        same clamp-safety contract as the rows shift)."""
+        return self.PCAP + (self.LOG_CW if self.tiered else 0)
 
     @staticmethod
     def _next_cap(cur: int, need: int, cap: int) -> int:
@@ -1655,7 +1991,7 @@ class DeviceChecker:
         return tcap
 
     def _grow_logs(self, bufs, need: int):
-        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        cap = self._capp()
         target = self._next_cap(self.PCAP, need, cap)
         while self.PCAP < target:
             pad = min(self.PCAP, target - self.PCAP)
@@ -1675,9 +2011,10 @@ class DeviceChecker:
         if self.rows_window == "frontier":
             return
         # doubling, capped at the most any run can use (SCAP states
-        # plus one blind append window) so a preset near-SCAP store is
-        # never forced to a wasteful next power of two
-        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        # plus one blind append window; budget-clamped in tiered mode)
+        # so a preset near-SCAP store is never forced to a wasteful
+        # next power of two
+        cap = self._capl()
         target = self._next_cap(self.LCAP, need, cap)
         while self.LCAP < target:
             pad = min(self.LCAP, target - self.LCAP)
@@ -1704,8 +2041,8 @@ class DeviceChecker:
         formulas the growers execute."""
         tcap, vcap = self.TCAP, self.VCAP
         lcap, pcap = self.LCAP, self.PCAP
-        capv = max(self.SCAP + self.ACAP, self.ACAP * 2)
-        capl = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        capv = self._capv()
+        capl = self._capl()
         frontier = self.rows_window == "frontier"
         out = [(tcap, vcap, lcap, pcap)]
         while True:
@@ -1745,7 +2082,7 @@ class DeviceChecker:
         K = self.K
         save = (self.TCAP if self.visited_impl == "fpset" else None,
                 self.VCAP, self.LCAP, self.PCAP)
-        cap = max(self.SCAP + self.ACAP, self.ACAP * 2)
+        cap = self._capv()
         fused = self.fuse == "level"
         if self.visited_impl == "fpset":
             while self.VCAP < cap:
@@ -1791,7 +2128,7 @@ class DeviceChecker:
         # the same reason as the flush above — the megakernel triple
         # walk below owns every store tier its run can touch.
         if self.rows_window == "all" and not fused:
-            capL = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+            capL = self._capl()
             n_inv = len(self.invariant_names)
             viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
             while self.LCAP < capL or self.PCAP < capL:
@@ -1804,12 +2141,13 @@ class DeviceChecker:
                 del rows_buf
                 app = self._append_jit()(
                     z((self._rows_len(),), jnp.uint32),
-                    z((self.PCAP,), jnp.int32),
-                    z((self.PCAP,), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
                     z((self.W, self.ACAP), jnp.uint32),
                     z((self.ACAP,), jnp.int32),
                     jnp.int32(0), jnp.int32(0), viol0, jnp.int32(0),
                     jnp.bool_(False), jnp.int32(0), jnp.bool_(True),
+                    jnp.int32(0),
                 )
                 drain(app)
                 del app
@@ -1844,7 +2182,7 @@ class DeviceChecker:
             # tier-keyed stage programs at exactly that tier so a warm
             # submit stays zero-compile (the r11 service contract)
             n_init = int(getattr(self.model, "n_initial", 0) or 0)
-            capl = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+            capl = self._capl()
             self.TCAP = self._next_table(
                 self.TCAP, n_init + self.ACAP, cap
             )
@@ -1873,12 +2211,13 @@ class DeviceChecker:
             if ("append", self.LCAP, self.PCAP) not in self._jits:
                 app = self._append_jit()(
                     z((self._rows_len(),), jnp.uint32),
-                    z((self.PCAP,), jnp.int32),
-                    z((self.PCAP,), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
                     z((self.W, self.ACAP), jnp.uint32),
                     z((self.ACAP,), jnp.int32),
                     jnp.int32(0), jnp.int32(0), viol0, jnp.int32(0),
                     jnp.bool_(False), jnp.int32(0), jnp.bool_(True),
+                    jnp.int32(0),
                 )
                 drain(app)
                 del app
@@ -1901,8 +2240,8 @@ class DeviceChecker:
             ),
             z((self.W, self.ACAP), jnp.uint32),
             z((self._rows_len(),), jnp.uint32),
-            z((self.PCAP,), jnp.int32),
-            z((self.PCAP,), jnp.int32),
+            z((self._logs_len(),), jnp.int32),
+            z((self._logs_len(),), jnp.int32),
             jnp.int32(0), BIG, viol0, z((FPM_N,), jnp.int32),
             z((WKM_N,), jnp.int32),
             jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
@@ -2004,10 +2343,10 @@ class DeviceChecker:
         viol0 = jnp.full((n_inv,), int(BIG), jnp.int32)
         app = self._append_jit()(
             z((self._rows_len(),), jnp.uint32),
-            z((self.PCAP,), jnp.int32), z((self.PCAP,), jnp.int32),
+            z((self._logs_len(),), jnp.int32), z((self._logs_len(),), jnp.int32),
             crows, idx_w, jnp.int32(0), jnp.int32(0), viol0,
             jnp.int32(0), jnp.bool_(False), jnp.int32(0),
-            jnp.bool_(True),
+            jnp.bool_(True), jnp.int32(0),
         )
         drain(app)
         mark("append")
@@ -2022,14 +2361,50 @@ class DeviceChecker:
             drain(self._stats_jit()(jnp.int32(0), BIG, viol0))
         drain(
             self._chain_jit(4)(
-                z((self.PCAP,), jnp.int32),
-                z((self.PCAP,), jnp.int32), jnp.int32(-1),
+                z((self._logs_len(),), jnp.int32),
+                z((self._logs_len(),), jnp.int32), jnp.int32(-1),
             )
         )
         mark("misc")
         if self.fuse == "level":
             drain(self._warm_fused(viol0))
             mark("fused")
+        if self.tiered:
+            K = self.K
+            tc = fpset.empty_cols(self.TCAP, K)
+            gen0 = z((self.TCAP + 1,), jnp.int32)
+            gen1 = self._tag_jit()(*tc, gen0, jnp.int32(1))
+            out = self._evict_jit()(*tc, gen1, jnp.int32(1))
+            drain(out)
+            drain(self._rehash_same_jit()(*out[:K]))
+            del tc, gen0, gen1, out
+            ak0 = tuple(
+                jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
+                for _ in range(K)
+            )
+            flag0 = z((self.ACAP,), jnp.uint32)
+            drain(self._sieve_jit()(*ak0, flag0))
+            drain(
+                self._unflag_jit()(
+                    flag0, z((self.UNFLAG_P,), jnp.int32),
+                    jnp.int32(0),
+                )
+            )
+            del ak0, flag0
+            drain(
+                self._logshift_jit()(
+                    z((self._logs_len(),), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
+                    jnp.int32(0), jnp.int32(0),
+                )
+            )
+            drain(
+                self._shift_jit()(
+                    z((self._rows_len(),), jnp.uint32),
+                    jnp.int32(0), jnp.int32(0),
+                )
+            )
+            mark("spill")
         if seed:
             write = self._seed_write_jit()
             if fpmode:
@@ -2056,8 +2431,8 @@ class DeviceChecker:
             drain(
                 write(
                     z((self._rows_len(),), jnp.uint32),
-                    z((self.PCAP,), jnp.int32),
-                    z((self.PCAP,), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
+                    z((self._logs_len(),), jnp.int32),
                     z((self.SEED_CHUNK, self.W), jnp.uint32),
                     z((self.SEED_CHUNK,), jnp.int32),
                     z((self.SEED_CHUNK,), jnp.int32), jnp.int32(0),
@@ -2121,6 +2496,21 @@ class DeviceChecker:
             self.last_stats.get("stage_compact_s", 0.0)
         )
         self._resume_meta = {}
+        # tiered-store per-run state (r16): fresh epochs/counters and a
+        # fresh TieredStore — a fresh (non-resume) run WIPES its spill
+        # dir (dead prior runs must not leak host/disk bytes); resume
+        # restores the cold tiers from the frame's manifest instead
+        self._spill_active = False
+        self._epoch = 1
+        self._hot_n = 0
+        self._spill_sync_n = 0
+        self._spill_emit_mark = 0
+        self._budget_overridden = False
+        if self.tiered and not resume:
+            # fresh runs own their spill dir; resume builds the store
+            # inside _restore_frame from the frame's manifest instead
+            self._mk_tstore()
+            self.tstore.wipe()
         # online adaptation (r15, tune/online.py): fresh controller
         # per run, probe schedule reset to the configured baseline —
         # an adapted pooled checker must not leak its adjustments
@@ -2234,6 +2624,9 @@ class DeviceChecker:
             # the ledger can split tuned vs default trajectories
             profile_sig=self.profile_sig,
             adapt=self.adapt,
+            # tiered-store budget (r16, schema v9): None on untiered
+            # runs — always present so spill trajectories split
+            hbm_budget=self.hbm_budget,
         )
         rm = self._resume_meta
         if resume and rm:
@@ -2326,9 +2719,13 @@ class DeviceChecker:
             ),
             "arows": jnp.zeros((self.W, self.ACAP), jnp.uint32),
             "rows": jnp.zeros((self._rows_len(),), jnp.uint32),
-            "parent": jnp.zeros((self.PCAP,), jnp.int32),
-            "lane": jnp.zeros((self.PCAP,), jnp.int32),
+            "parent": jnp.zeros((self._logs_len(),), jnp.int32),
+            "lane": jnp.zeros((self._logs_len(),), jnp.int32),
         }
+        if self.tiered:
+            # per-slot eviction generations (0 = empty/untagged);
+            # tagged once per level boundary (store/sieve.py)
+            bufs["gen"] = jnp.zeros((self.TCAP + 1,), jnp.int32)
         st = {
             "n_visited": jnp.int32(0),
             "dead_gid": BIG,
@@ -2383,6 +2780,29 @@ class DeviceChecker:
                     f"initial level ({n_init} states) exceeds the "
                     f"frontier rows window; raise row_cap_states"
                 )
+            if self.tiered and (
+                n_init + self.APAD > min(self._capl(), self._capp())
+                or n_init + self.ACAP > self._capv()
+            ):
+                # level 1 lands before any spill boundary exists:
+                # honor it past the budget (warned once) — the same
+                # correctness-first valve as the frontier windows.
+                # The TABLE ceiling rises too: the whole level must be
+                # hot until the first boundary can evict
+                self._lcap_max = max(
+                    self._lcap_max, n_init + self.APAD
+                )
+                self._pcap_max = max(
+                    self._pcap_max, n_init + self.APAD
+                )
+                while self._tcap_max // 2 < n_init + self.ACAP:
+                    self._tcap_max *= 2
+                if not self._budget_overridden:
+                    self._budget_overridden = True
+                    self._log(
+                        "WARNING: hbm_budget too small for the "
+                        "initial level — growing past the budget"
+                    )
             self._grow_visited(bufs, n_init + self.ACAP)
             self._grow_store(bufs, n_init + self.APAD)
             w = 0
@@ -2446,6 +2866,13 @@ class DeviceChecker:
         self._fetch_n += 1
         nv = int(out[0])
         self._snap["distinct_states"] = nv
+        if self.tiered and (
+            self.tstore is None or not self.tstore.has_cold_keys
+        ):
+            # before the first eviction the hot table holds exactly
+            # the distinct set; afterwards _resolve_cold_misses tracks
+            # inserts per flush
+            self._hot_n = nv
         # work-unit accounting (r14): a fused stats vector carries the
         # in-kernel work counters — fold their deltas into the per-run
         # ``work_*`` totals; whatever part of the nv delta the kernel
@@ -2593,6 +3020,15 @@ class DeviceChecker:
             )
             bufs["vk"] = out[:K]
             n_new, flag_acc = out[K], out[K + 1]
+        if self.tiered:
+            # cold-tier miss resolution (r16): lanes the hot filter
+            # flagged new may be duplicates of EVICTED keys; resolve
+            # the sieved batch against the cold runs and merge the
+            # verdicts back BEFORE the compaction that assigns gids —
+            # tiered gid assignment stays identical to untiered
+            n_new, flag_acc = self._resolve_cold_misses(
+                bufs, flag_acc, n_new
+            )
         # compact in its own dispatch (round 10): per-dispatch stage
         # accounting, and the donated accumulator comes back as the
         # compacted matrix — recycled below as the next fill's buffer
@@ -2614,7 +3050,277 @@ class DeviceChecker:
                 crows, idx, n_new, st["n_visited"],
                 st["viol"], jnp.int32(acc_base), jnp.bool_(is_init),
                 jnp.int32(rb["row_base"]), jnp.bool_(rb["rows_ok"]),
+                jnp.int32(rb["row_base"] if self.tiered else 0),
             ),
+        )
+
+    # ------------------------------------ tiered-store orchestration
+
+    def _mk_tstore(self) -> None:
+        """Fresh TieredStore for this run (durable when the run
+        checkpoints — spill files live beside the frame under
+        ``<checkpoint_path>.spill/`` so suspend/crash resume restores
+        the whole tiered store through the frame's manifest)."""
+        if self.tstore is not None:
+            self.tstore.close()
+        sdir = self._spill_dir_arg or (
+            f"{self.checkpoint_path}.spill"
+            if self.checkpoint_path
+            else None
+        )
+        self.tstore = TieredStore(
+            self.K,
+            spill_dir=sdir,
+            compress=self.spill_compress,
+            durable=bool(self.checkpoint_path),
+            miss_batch=self.miss_batch,
+        )
+
+    def _spill_tier_label(self) -> str:
+        return "ram+disk" if self.tstore.durable else "ram"
+
+    def _resolve_cold_misses(self, bufs, flag_acc, n_new):
+        """Sieve the flush's hot-filter survivors, resolve them
+        against the cold runs in ``miss_batch``-wide batches, and
+        clear the false-new lanes.  Returns the corrected
+        ``(n_new, flag_acc)``.  No cold keys yet = free (the hot
+        verdict is exact; ``_hot_n`` tracks lazily off the fetches)."""
+        if not self.tstore.has_cold_keys:
+            return n_new, flag_acc
+        K = self.K
+        out = self._stage_mark(
+            "sieve", self._sieve_jit()(*bufs["ak"], flag_acc)
+        )
+        kc, lanes, n_dev = out[:K], out[K], out[K + 1]
+        n = int(np.asarray(n_dev))
+        self._spill_sync_n += 1
+        false_lanes = []
+        for off in range(0, n, self.miss_batch):
+            m = min(self.miss_batch, n - off)
+            t0 = time.perf_counter()
+            kq = [np.asarray(c[off: off + m]) for c in kc]
+            lq = np.asarray(lanes[off: off + m])
+            self.tstore.note_transfer(time.perf_counter() - t0)
+            dup = self.tstore.lookup_keys(kq)
+            if dup.any():
+                false_lanes.append(lq[dup])
+        self._hot_n += n
+        if not false_lanes:
+            return jnp.int32(n), flag_acc
+        fl = np.concatenate(false_lanes).astype(np.int32)
+        k = len(fl)
+        P = self.UNFLAG_P
+        for off in range(0, k, P):
+            chunk = fl[off: off + P]
+            padded = np.zeros((P,), np.int32)
+            padded[: len(chunk)] = chunk
+            flag_acc = self._stage_mark(
+                "unflag",
+                self._unflag_jit()(
+                    flag_acc, jnp.asarray(padded),
+                    jnp.int32(len(chunk)),
+                ),
+            )
+        return jnp.int32(n - k), flag_acc
+
+    def _evict_cold_keys(self, bufs, cutoff: int) -> int:
+        """Evict generations <= cutoff to the cold tier: extract +
+        device-sort, D2H the dense prefix, rehash the survivors (probe
+        chains break across holes), restart the epoch clock.  Returns
+        the evicted count."""
+        K = self.K
+        out = self._stage_mark(
+            "evict",
+            self._evict_jit()(
+                *bufs["vk"], bufs["gen"], jnp.int32(cutoff)
+            ),
+        )
+        holed, gen = out[:K], out[K]
+        ev, n_dev = out[K + 1: 2 * K + 1], out[2 * K + 1]
+        n = int(np.asarray(n_dev))
+        if n == 0:
+            # nothing at or below the cutoff: keep the (unchanged)
+            # table — where(False, ...) returned the originals
+            bufs["vk"], bufs["gen"] = holed, gen
+            return 0
+        t0 = time.perf_counter()
+        ev_np = [np.asarray(c[:n]) for c in ev]
+        self.tstore.note_transfer(time.perf_counter() - t0)
+        out2 = self._stage_mark(
+            "evict", self._rehash_same_jit()(*holed)
+        )
+        vk, failed = out2[:K], out2[K]
+        if int(np.asarray(failed)):
+            raise RuntimeError(
+                "fpset rehash overflow during eviction — load-factor "
+                "contract broken (bug)"
+            )
+        bufs["vk"] = vk
+        # survivors restart at the base generation (their finer ages
+        # died with the old slot layout — documented coarsening)
+        bufs["gen"] = self._tag_jit()(
+            *vk, jnp.zeros((self.TCAP + 1,), jnp.int32), jnp.int32(1)
+        )
+        self._epoch = 2
+        self.tstore.evict_keys(ev_np)
+        self._hot_n -= n
+        self._spill_active = True
+        self._log(
+            f"spill: evicted {n} cold keys to the "
+            f"{self._spill_tier_label()} tier (hot {self._hot_n})"
+        )
+        return n
+
+    def _ensure_hot_capacity(self, bufs, head: int) -> None:
+        """The tiered replacement for unbounded visited growth: admit
+        ``head`` more states in the hot table by growing WITHIN the
+        budget, else by evicting cold generations; only when neither
+        suffices does the budget get overridden (correctness first,
+        with a warning)."""
+        if self._hot_n + head <= self.VCAP:
+            return
+        if self.TCAP < self._tcap_max:
+            self._grow_visited(bufs, self._hot_n + head)
+            if self._hot_n + head <= self.VCAP:
+                return
+        # evict everything except the newest tagged generation, then
+        # (if still short) everything tagged
+        for cutoff in (self._epoch - 2, self._epoch - 1):
+            if cutoff >= 1 and self._hot_n + head > self.VCAP:
+                self._evict_cold_keys(bufs, cutoff)
+        if self._hot_n + head <= self.VCAP:
+            return
+        # nothing evictable (the live level alone overflows the
+        # budgeted table): grow past the budget rather than abort
+        if not self._budget_overridden:
+            self._budget_overridden = True
+            self._log(
+                "WARNING: hbm_budget too small for the live frontier "
+                "— growing the hot table past the budget"
+            )
+        self._tcap_max *= 2
+        self._grow_visited(bufs, self._hot_n + head)
+
+    def _spill_aged(self, bufs, rb, upto: int, nv: int) -> None:
+        """Spill rows + trace logs of [row_base, upto) to the cold
+        tier and slide both device windows down (rows and logs share
+        one base in tiered mode)."""
+        base = rb["row_base"]
+        if upto <= base:
+            return
+        W = self.W
+        t0 = time.perf_counter()
+        rows_np = np.asarray(bufs["rows"][: (upto - base) * W])
+        par_np = np.asarray(bufs["parent"][: upto - base])
+        lan_np = np.asarray(bufs["lane"][: upto - base])
+        self.tstore.note_transfer(time.perf_counter() - t0)
+        self.tstore.spill_rows(base, upto, rows_np)
+        self.tstore.spill_logs(base, upto, par_np, lan_np)
+        n_keep = nv - upto
+        bufs["rows"] = self._shift_jit()(
+            bufs["rows"], jnp.int32(upto - base), jnp.int32(n_keep)
+        )
+        bufs["parent"], bufs["lane"] = self._logshift_jit()(
+            bufs["parent"], bufs["lane"], jnp.int32(upto - base),
+            jnp.int32(n_keep),
+        )
+        rb["row_base"] = upto
+        self._spill_active = True
+
+    def _tiered_ensure_windows(self, bufs, rb, level_base: int,
+                               need_abs: int, nv: int) -> None:
+        """Admit ``need_abs`` absolute states in the row/log windows:
+        spill the aged range first, then grow within the budget, and
+        only past both override the budget (warning)."""
+        need = need_abs - rb["row_base"]
+        if need <= min(self.LCAP, self.PCAP):
+            return
+        if level_base > rb["row_base"]:
+            self._spill_aged(bufs, rb, level_base, nv)
+            need = need_abs - rb["row_base"]
+        if need <= min(self.LCAP, self.PCAP):
+            return
+        if self.LCAP < self._lcap_max or self.PCAP < self._pcap_max:
+            self._grow_store(bufs, need)
+            need = need_abs - rb["row_base"]
+        if need <= min(self.LCAP, self.PCAP):
+            return
+        if not self._budget_overridden:
+            self._budget_overridden = True
+            self._log(
+                "WARNING: hbm_budget too small for the live frontier "
+                "windows — growing past the budget"
+            )
+        self._lcap_max = max(self._lcap_max * 2, need)
+        self._pcap_max = max(self._pcap_max * 2, need)
+        self._grow_store(bufs, need)
+
+    def _tiered_pressure(self, nv: int, nf: int,
+                         row_base: int) -> bool:
+        """Would the next level's worst case overflow the budget-
+        capped tiers?  True latches ``_spill_active`` — the fused
+        megakernel hands the level loop to the spill-aware stage
+        path (the budget consult that replaces truncation)."""
+        if self._spill_active:
+            return True
+        hot = self._hot_n + 2 * self.ACAP > self._capv()
+        win = (
+            nv - row_base + self.APAD + self.G
+            > min(self._lcap_max, self._pcap_max)
+        )
+        if hot or win:
+            self._spill_active = True
+        return self._spill_active
+
+    def _tiered_boundary(self, bufs, st, rb, level_base: int,
+                         nf: int, nv: int, level: int) -> None:
+        """Level-boundary spill housekeeping: tag the epoch, spill
+        aged rows/logs once spilling is active, keep the hot table
+        inside the budget, and emit the cumulative ``spill`` record
+        (after joining the async transfers so byte counts are
+        final)."""
+        bufs["gen"] = self._tag_jit()(
+            *bufs["vk"], bufs["gen"], jnp.int32(self._epoch)
+        )
+        self._epoch += 1
+        # window pressure for the NEXT level: frontier + expand slack
+        # + one blind append window
+        self._tiered_ensure_windows(
+            bufs, rb, level_base, level_base + nf + self.G + self.APAD,
+            nv,
+        )
+        if self._spill_active and level_base > rb["row_base"]:
+            self._spill_aged(bufs, rb, level_base, nv)
+        self._ensure_hot_capacity(bufs, 2 * self.ACAP)
+        self._emit_spill(level)
+
+    def _emit_spill(self, level: int) -> None:
+        """One cumulative ``spill`` record per boundary with new spill
+        work (schema v9; the validator cross-checks monotonicity)."""
+        if self.tstore is None:
+            return
+        s = self.tstore.stats
+        mark = (
+            s.evictions + s.keys_evicted + s.rows_evicted
+            + s.misses_resolved
+        )
+        if mark == self._spill_emit_mark or not self.tel.enabled:
+            return
+        self.tstore.flush()  # byte counts final; waits are measured
+        self._spill_emit_mark = mark
+        self.tel.emit(
+            "spill",
+            tier=self._spill_tier_label(),
+            level=level,
+            keys_evicted=int(s.keys_evicted),
+            rows_evicted=int(s.rows_evicted),
+            bytes_raw=int(s.bytes_raw),
+            bytes_comp=int(s.bytes_comp),
+            transfer_s=round(s.transfer_s, 4),
+            misses_resolved=int(s.misses_resolved),
+            miss_hits=int(s.miss_hits),
+            evictions=int(s.evictions),
+            hot_keys=int(self._hot_n),
         )
 
     def _run_recoverable(
@@ -2688,6 +3394,7 @@ class DeviceChecker:
         ACAP stays within VCAP and LCAP.  The current frontier is the
         contiguous row-store range [level_base, level_base + nf)."""
         K = self.K
+        self._last_rb = rb  # the tiered trace walk needs the log base
         nv = int(stats[0])
         while True:
             reason = self._stop_reason(stats, t0)
@@ -2752,7 +3459,16 @@ class DeviceChecker:
             # frontier end, so the store must cover it or the
             # dynamic_slice would clamp and re-expand shifted rows
             # while silently never expanding the level's tail
-            if self.rows_window == "frontier":
+            if self.tiered:
+                # window assurance for THIS level (idempotent — the
+                # boundary hook already sized it for steady state, but
+                # the first level after init/seed/restore lands here
+                # first)
+                self._tiered_ensure_windows(
+                    bufs, rb, level_base,
+                    level_base + nf + self.G + self.APAD, nv,
+                )
+            elif self.rows_window == "frontier":
                 self._grow_logs(bufs, level_base + nf + self.G)
                 if not rb["rows_ok"]:
                     # the level about to be expanded lost rows to the
@@ -2788,7 +3504,10 @@ class DeviceChecker:
                 # dispatch time (_grow_fused) so the tier triple stays
                 # on the prewarmed staircase
                 self._grow_store(bufs, level_base + nf + self.G)
-            if self.fuse == "level":
+            if self.fuse == "level" and not (
+                self.tiered
+                and self._tiered_pressure(nv, nf, rb["row_base"])
+            ):
                 (
                     stats, nv, level_base, nf, stop, partial,
                 ) = self._fused_level_pass(
@@ -2813,6 +3532,11 @@ class DeviceChecker:
                         )
                     return self._result(
                         t0, nv, level_sizes, bufs, **reason
+                    )
+                if self.tiered and nf:
+                    self._tiered_boundary(
+                        bufs, st, rb, level_base, nf, nv,
+                        len(level_sizes),
                     )
                 if (
                     self.checkpoint_path
@@ -2867,6 +3591,15 @@ class DeviceChecker:
                     # most ACAP states, and the append writes a blind
                     # APAD-row window past the running n_visited
                     nv_bound = nv + (pending + 1) * self.ACAP
+                    # tiered mode bounds the HOT table (cold-duplicate
+                    # inserts count; evicted keys do not) and the
+                    # window-relative store offsets
+                    hot_bound = (
+                        self._hot_n + (pending + 1) * self.ACAP
+                        if self.tiered
+                        else nv_bound
+                    )
+                    log_off = rb["row_base"] if self.tiered else 0
                     rows_full = (
                         self.rows_window == "frontier"
                         and rb["rows_ok"]
@@ -2874,11 +3607,17 @@ class DeviceChecker:
                         + self.APAD > self.LCAP
                     )
                     need_sync = (
-                        nv_bound > self.VCAP
-                        or nv_bound - self.ACAP + self.APAD > self.PCAP
+                        hot_bound > self.VCAP
+                        or nv_bound - self.ACAP - log_off + self.APAD
+                        > self.PCAP
                         or nv_bound - self.ACAP >= self.SCAP
                         or rows_full
                         or pending >= self.group
+                        or (
+                            self.tiered
+                            and nv_bound - self.ACAP - log_off
+                            + self.APAD > self.LCAP
+                        )
                     )
                     if need_sync:
                         stats = self._fetch(st)
@@ -2906,9 +3645,19 @@ class DeviceChecker:
                             if self.rec.headroom_frozen
                             else (self.group + 1) * self.ACAP
                         )
-                        if nv + self.ACAP > self.VCAP:
+                        if self.tiered:
+                            # the budget consult that replaces
+                            # truncation: grow within it, evict past it
+                            self._ensure_hot_capacity(bufs, head)
+                            self._tiered_ensure_windows(
+                                bufs, rb, level_base,
+                                nv + head + self.APAD, nv,
+                            )
+                        elif nv + self.ACAP > self.VCAP:
                             self._grow_visited(bufs, nv + head)
-                        if nv + self.APAD > self.PCAP:
+                        if not self.tiered and (
+                            nv + self.APAD > self.PCAP
+                        ):
                             self._grow_store(
                                 bufs, nv + head + self.APAD
                             )
@@ -2985,6 +3734,10 @@ class DeviceChecker:
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             level_base += nf
             nf = level_count
+            if self.tiered and nf:
+                self._tiered_boundary(
+                    bufs, st, rb, level_base, nf, nv, len(level_sizes)
+                )
             # (frontier mode: the rows_ok check and the frontier shift
             # happen at the TOP of the next iteration, so the seeded
             # first level takes the same path as every later level)
@@ -3120,6 +3873,7 @@ class DeviceChecker:
                 )
                 self._grow_fused(bufs, nv + head)
                 lv_cap = self._levels_cap(nf, len(level_sizes))
+                nv_in = nv
                 fl_before = (
                     int(fpset.fpm_logical(self._last_fpm)[0])
                     if self._last_fpm is not None
@@ -3162,6 +3916,19 @@ class DeviceChecker:
                 ]
                 if self.rows_window == "frontier":
                     rb["rows_ok"] = bool(rows_ok_i)
+                if (
+                    self.tiered
+                    and n_lv == 0
+                    and w_off2 == w_off
+                    and nv == nv_in
+                ):
+                    # the kernel's capacity guard refused to run and
+                    # growth is budget-capped: latch spilling and hand
+                    # the level to the stage path (idempotent dedup
+                    # re-derives any partial progress exactly)
+                    self._spill_active = True
+                    level_base, nf = lb2, nf2
+                    break
                 self._replay_flush_faults(st, fl_before)
                 wd = self._last_wkm_delta
                 self.tel.emit(
@@ -3306,6 +4073,7 @@ class DeviceChecker:
             visited_impl=self.visited_impl,
             rows_window=self.rows_window,
             engine="device_bfs_r7",
+            **({"tiered": True} if self.tiered else {}),
         )
 
     def _can_recover(self) -> bool:
@@ -3332,7 +4100,13 @@ class DeviceChecker:
             return False
         t_stall = time.perf_counter()
         W = self.W
-        lo = 0 if self.rows_window == "all" else level_base
+        # tiered frames save the device WINDOW only — everything older
+        # is in the cold tiers the embedded spill manifest describes
+        lo = (
+            rb["row_base"] if self.tiered
+            else 0 if self.rows_window == "all"
+            else level_base
+        )
         arrays = {
             "n_visited": np.int64(nv),
             "level_sizes": np.asarray(level_sizes, np.int64),
@@ -3345,8 +4119,14 @@ class DeviceChecker:
                 if self.visited_impl == "fpset"
                 else np.zeros((FPM_N,), np.int32)
             ),
-            "parent": np.asarray(bufs["parent"][:nv]),
-            "lane": np.asarray(bufs["lane"][:nv]),
+            # logs are windowed ONLY in tiered mode (frontier mode
+            # windows the rows but keeps full logs)
+            "parent": np.asarray(
+                bufs["parent"][: nv - (lo if self.tiered else 0)]
+            ),
+            "lane": np.asarray(
+                bufs["lane"][: nv - (lo if self.tiered else 0)]
+            ),
             "rows": np.asarray(
                 bufs["rows"][
                     (lo - rb["row_base"]) * W:
@@ -3367,6 +4147,19 @@ class DeviceChecker:
                 # sorted columns: the first nv entries are the real
                 # keys (SENTINEL pad sorts behind every real key)
                 arrays[f"vk{i}"] = np.asarray(col[:nv])
+        if self.tiered:
+            # the spill manifest: every cold run/segment with file
+            # names + content digests, so resume restores the WHOLE
+            # tiered store (manifest() joins the async writes first —
+            # a frame never references a half-written spill file)
+            import json as _json
+
+            arrays["spill_manifest"] = np.frombuffer(
+                _json.dumps(self.tstore.manifest()).encode(),
+                dtype=np.uint8,
+            )
+            arrays["spill_hot_n"] = np.int64(self._hot_n)
+            arrays["spill_epoch"] = np.int64(self._epoch)
         nbytes, write_s, retries = ckpt.save_frame(
             self.checkpoint_path, self._config_sig(), arrays,
             wall_s=time.time() - t0,
@@ -3460,9 +4253,11 @@ class DeviceChecker:
                 for i in range(K)
             )
         # size the row/log tiers BEFORE allocating (same doubling-with-
-        # cap formulas as _grow_store/_grow_logs, minus the buffers)
-        need = nv + self.APAD
-        cap = max(self.SCAP + self.APAD, self.NCs + self.APAD)
+        # cap formulas as _grow_store/_grow_logs, minus the buffers).
+        # Tiered frames hold the device WINDOW only, so the need is
+        # window-relative
+        need = (nv - lo if self.tiered else nv) + self.APAD
+        cap = self._capl()
         if self.rows_window == "all":
             while self.LCAP < need:
                 self.LCAP += min(
@@ -3500,16 +4295,54 @@ class DeviceChecker:
             "parent": jnp.concatenate(
                 [
                     jnp.asarray(np.asarray(d["parent"], np.int32)),
-                    jnp.zeros((self.PCAP - nv,), jnp.int32),
+                    jnp.zeros(
+                        (
+                            self._logs_len()
+                            - (nv - (lo if self.tiered else 0)),
+                        ),
+                        jnp.int32,
+                    ),
                 ]
             ),
             "lane": jnp.concatenate(
                 [
                     jnp.asarray(np.asarray(d["lane"], np.int32)),
-                    jnp.zeros((self.PCAP - nv,), jnp.int32),
+                    jnp.zeros(
+                        (
+                            self._logs_len()
+                            - (nv - (lo if self.tiered else 0)),
+                        ),
+                        jnp.int32,
+                    ),
                 ]
             ),
         }
+        if self.tiered:
+            # restore the cold tiers through the frame's manifest
+            # (digest-verified; a torn spill file fails loudly) and
+            # restart the epoch clock with all hot keys at the base
+            # generation
+            import json as _json
+
+            if "spill_manifest" not in d:
+                raise ValueError(
+                    "tiered resume needs a spill manifest in the "
+                    "frame — this frame was written untiered"
+                )
+            self._mk_tstore()
+            self.tstore.restore(
+                _json.loads(d["spill_manifest"].tobytes().decode())
+            )
+            self._hot_n = int(d["spill_hot_n"])
+            self._epoch = 2
+            self._spill_active = bool(
+                self.tstore.has_cold_keys or self.tstore._rows
+            )
+            bufs["gen"] = self._tag_jit()(
+                *bufs["vk"],
+                jnp.zeros((self.TCAP + 1,), jnp.int32),
+                jnp.int32(1),
+            )
         n_inv = len(self.invariant_names)
         st = {
             "n_visited": jnp.int32(nv),
@@ -3637,7 +4470,16 @@ class DeviceChecker:
 
     def _trace(self, bufs, gid: int, max_depth: int):
         """Walk the parent chain on device (one fetch), replay lanes
-        through the oracle on the host (SURVEY.md §2.2-E7)."""
+        through the oracle on the host (SURVEY.md §2.2-E7).  Tiered
+        runs whose aged logs spilled walk the merged cold+device logs
+        host-side instead — the chain is depth-bounded, so the host
+        walk is off every hot path."""
+        if (
+            self.tiered
+            and getattr(self, "_last_rb", None) is not None
+            and self._last_rb["row_base"] > 0
+        ):
+            return self._trace_tiered(bufs, gid, max_depth)
         gids, lanes, g_end = self._chain_jit(max_depth)(
             bufs["parent"], bufs["lane"], jnp.int32(gid)
         )
@@ -3659,6 +4501,9 @@ class DeviceChecker:
         init_idx = -1 - g_end
         chain.reverse()
         lanes = [lane for _gid, lane in chain[1:]]
+        return self._replay_chain(init_idx, lanes)
+
+    def _replay_chain(self, init_idx: int, lanes):
         replay = getattr(self.model, "replay_trace", None)
         if replay is None:
             # hand models beside compaction (bookkeeper, subscription,
@@ -3669,6 +4514,37 @@ class DeviceChecker:
 
             return replay_lane_trace(self.model, init_idx, lanes)
         return replay(init_idx, lanes)
+
+    def _trace_tiered(self, bufs, gid: int, max_depth: int):
+        """Host-side chain walk over the merged logs: the cold tiers
+        stream the aged [0, row_base) ranges back, the device window
+        supplies the tail — gid indexing is absolute either way."""
+        base = self._last_rb["row_base"]
+        nv = int(self._trace_nv)
+        cold_par, cold_lan = self.tstore.fetch_logs(0, base)
+        par = np.concatenate(
+            [cold_par, np.asarray(bufs["parent"][: nv - base])]
+        )
+        lan = np.concatenate(
+            [cold_lan, np.asarray(bufs["lane"][: nv - base])]
+        )
+        chain = []
+        g = int(gid)
+        for _ in range(max_depth):
+            if g < 0:
+                break
+            chain.append((g, int(lan[g])))
+            g = int(par[g])
+        else:
+            raise RuntimeError(
+                "parent chain did not terminate at an initial state "
+                f"(depth {max_depth}, last gid {g}) — trace log "
+                "corrupt"
+            )
+        init_idx = -1 - g
+        chain.reverse()
+        lanes = [lane for _gid, lane in chain[1:]]
+        return self._replay_chain(init_idx, lanes)
 
     # ------------------------------------------------------------ result
 
@@ -3717,6 +4593,35 @@ class DeviceChecker:
             / max(len(level_sizes), 1),
             2,
         )
+        # tiered-store telemetry (r16): cumulative spill counters +
+        # the two headline economy signals — compressed spill bytes
+        # per distinct state (the 1B-state byte-rate arithmetic's
+        # input) and the overlap ratio (1.0 = boundaries never waited
+        # on a transfer)
+        if self.tiered and self.tstore is not None:
+            self.tstore.flush()
+            sp = self.tstore.stats
+            self.last_stats.update(
+                hbm_budget=self.hbm_budget,
+                spill_evictions=int(sp.evictions),
+                spill_keys_evicted=int(sp.keys_evicted),
+                spill_rows_evicted=int(sp.rows_evicted),
+                spill_bytes_raw=int(sp.bytes_raw),
+                spill_bytes_comp=int(sp.bytes_comp),
+                spill_transfer_s=round(sp.transfer_s, 3),
+                spill_misses_resolved=int(sp.misses_resolved),
+                spill_miss_hits=int(sp.miss_hits),
+                spill_syncs=int(self._spill_sync_n),
+                spill_hot_keys=int(self._hot_n),
+                spill_overlap_ratio=sp.overlap_ratio,
+                spill_bytes_per_state=round(
+                    sp.bytes_comp / max(nv, 1), 2
+                ),
+            )
+            self._emit_spill(len(level_sizes))
+            # run over: release the spill worker thread (the in-RAM
+            # tiers stay readable for the trace walk / liveness sweep)
+            self.tstore.quiesce()
         # survivability telemetry for bench artifacts (r7/r8/r9)
         self.last_stats.update(
             fuse=self.fuse,
@@ -3750,6 +4655,7 @@ class DeviceChecker:
             gid = dead_gid
         if gid is not None:
             res.violation_gid = gid
+            self._trace_nv = nv
             if getattr(self, "_bufs_poisoned", False):
                 # after RESOURCE_EXHAUSTED the parent/lane logs may hold
                 # donated/poisoned storage — walking them could crash or

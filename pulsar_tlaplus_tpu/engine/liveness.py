@@ -106,6 +106,8 @@ class LivenessChecker:
         sweep_chunk: Optional[int] = None,
         sweep_group: Optional[int] = None,
         compact_impl: Optional[str] = None,
+        hbm_budget=None,
+        spill_compress: Optional[bool] = None,
         profile=None,
         n_devices: int = 1,
         explorer_kw: Optional[dict] = None,
@@ -209,6 +211,23 @@ class LivenessChecker:
             checkpoint_every=checkpoint_every,
             compact_impl=compact_impl,
         )
+        # resolve the ctor-or-PTT_HBM_BUDGET budget HERE so the env
+        # var gets the same gating/forwarding as the explicit knob
+        from pulsar_tlaplus_tpu.store import budget as store_budget
+
+        hbm_budget = store_budget.resolve_budget(hbm_budget)
+        if hbm_budget is not None and n_devices > 1:
+            raise ValueError(
+                "hbm_budget needs the single-device explorer (the "
+                "sharded engine has no tiered store yet)"
+            )
+        if n_devices <= 1 and hbm_budget is not None:
+            # tiered exploration (r16): the inner explorer spills aged
+            # rows to the host store; the sweep streams them back
+            # tier by tier below (_explore)
+            inner_kw.setdefault("hbm_budget", hbm_budget)
+            if spill_compress is not None:
+                inner_kw.setdefault("spill_compress", spill_compress)
         if n_devices <= 1:
             # the single-chip explorer resolves its OWN tuned profile
             # (keyed engine="device_bfs"); the sharded engine has no
@@ -345,6 +364,30 @@ class LivenessChecker:
                 for s in range(self._checker.N)
             ]
             self._rows_flat = jnp.asarray(np.concatenate(firsts + rests))
+        elif (
+            getattr(self._checker, "tiered", False)
+            and self._checker.tstore is not None
+            and self._checker.tstore.rows_spilled_hi > 0
+        ):
+            # tiered exploration (r16): the aged row ranges live in
+            # the cold tiers — stream them back tier by tier, in gid
+            # order, and append the device window's tail.  The
+            # EXPLORER never had to keep every row in HBM; the sweep
+            # itself still materializes the full matrix for its
+            # key->gid table (chunking the sweep's own table is the
+            # ROADMAP follow-up — at virtual-mesh scales this is host
+            # RAM, like the sharded branch above).
+            ck = self._checker
+            base = ck.tstore.rows_spilled_hi
+            W = self.model.layout.W
+            n = res.distinct_states
+            cold = ck.tstore.fetch_rows(0, base, W)
+            devpart = np.asarray(
+                ck.last_bufs["rows"][: (n - base) * W]
+            )
+            self._rows_flat = jnp.asarray(
+                np.concatenate([cold, devpart])
+            )
         else:
             self._rows_flat = self._checker.last_bufs["rows"]
         # the sweep only reads the flat rows: drop the explorer's
@@ -352,7 +395,14 @@ class LivenessChecker:
         # available for the sweep's full-table join temps (in the
         # sharded branch the per-shard rows too — _rows_flat already
         # holds the copy)
-        keep = () if self.n_devices > 1 else ("rows",)
+        keep = (
+            ()
+            if self.n_devices > 1
+            or self._rows_flat is not self._checker.last_bufs.get(
+                "rows"
+            )
+            else ("rows",)
+        )
         for k in list(self._checker.last_bufs):
             if k not in keep:
                 del self._checker.last_bufs[k]
@@ -1020,6 +1070,7 @@ class LivenessChecker:
             # v8: the liveness engine's own tuned-profile attribution
             # (the inner explorer's header carries its own)
             profile_sig=self.profile_sig,
+            hbm_budget=getattr(self._checker, "hbm_budget", None),
             wall_unix=round(time.time(), 3),
             goal=self.goal_name,
             fairness=self.fairness,

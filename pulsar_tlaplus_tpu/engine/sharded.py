@@ -518,6 +518,7 @@ class ShardedChecker:
             # v8 envelope: not profile-tuned yet; the field must
             # still exist (schema v8 run_header contract)
             profile_sig=None,
+            hbm_budget=None,
             wall_unix=round(time.time(), 3),
             max_states=self.max_states,
             invariants=list(self.invariant_names),

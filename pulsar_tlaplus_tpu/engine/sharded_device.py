@@ -1930,6 +1930,7 @@ class ShardedDeviceChecker:
             # v8 envelope: the sharded engine is not profile-tuned
             # yet; the field must still exist (schema v8 contract)
             profile_sig=None,
+            hbm_budget=None,
             wall_unix=round(time.time(), 3),
             max_states=self.SCAP,
             sub_batch=self.G,

@@ -58,11 +58,23 @@ GATE_DIRECTIONS: Dict[str, str] = {
     "sustained_final_60s_sps": "higher",
     "sustained_last_level_sps": "higher",
     "distinct_states": "higher",
+    # tiered-store economy (r16): compressed spill bytes per distinct
+    # state is deterministic on a fixed codec (the 1B byte-rate
+    # arithmetic's input); the overlap ratio gates real-chip
+    # trajectories (timing-dependent — NOT in the deterministic set)
+    "spill_bytes_per_state": "lower",
+    "spill_overlap_ratio": "higher",
 }
 # the machine-independent subset — the tier-1 gate's default
 DETERMINISTIC_GATE_KEYS = (
     "dispatches_per_level", "work_units_per_state",
 )
+# the spill-path deterministic subset (byte counts are
+# codec-deterministic): like DETERMINISTIC_GATE_KEYS above, this is
+# the documented key set the tier-1 spill gate passes EXPLICITLY
+# (tests/test_store.py) when gating a tiered record against the
+# committed tiered baseline
+SPILL_GATE_KEYS = ("spill_bytes_per_state",)
 
 
 def _digest(values: dict) -> str:
@@ -125,6 +137,12 @@ def _derive(values: dict) -> dict:
         )
         if work:
             values["work_units_per_state"] = round(work / n, 2)
+        comp = values.get("spill_bytes_comp")
+        if (
+            "spill_bytes_per_state" not in values
+            and isinstance(comp, (int, float))
+        ):
+            values["spill_bytes_per_state"] = round(comp / n, 2)
     return values
 
 

@@ -184,10 +184,39 @@ def _engine_families(
             "Deduped rows landed by the append stage",
         ).add(stats.get("work_append_rows")),
     ]
+    # tiered-store spill families (r16): the budget knob's live
+    # observables — eviction traffic, raw-vs-compressed bytes, miss
+    # resolution, and transfer seconds (docs/memory.md)
+    spill_fams = [
+        Family(
+            "ptt_spill_keys_evicted_total", "counter",
+            "Visited keys evicted to the cold tiers",
+        ).add(stats.get("spill_keys_evicted")),
+        Family(
+            "ptt_spill_rows_evicted_total", "counter",
+            "Aged row-store states spilled to the cold tiers",
+        ).add(stats.get("spill_rows_evicted")),
+        Family(
+            "ptt_spill_bytes_raw_total", "counter",
+            "Raw bytes spilled (pre-compression plane width)",
+        ).add(stats.get("spill_bytes_raw")),
+        Family(
+            "ptt_spill_bytes_comp_total", "counter",
+            "Encoded bytes spilled (delta + zlib)",
+        ).add(stats.get("spill_bytes_comp")),
+        Family(
+            "ptt_spill_transfer_seconds_total", "counter",
+            "Spill transfer work (D2H gather + encode + write)",
+        ).add(stats.get("spill_transfer_s")),
+        Family(
+            "ptt_spill_misses_resolved_total", "counter",
+            "Hot-filter survivors resolved against the cold tiers",
+        ).add(stats.get("spill_misses_resolved")),
+    ]
     return [
         f_distinct, f_rate, f_level, f_frontier, f_occ, f_probe,
         f_lanes, f_flushes, f_hbm, f_frames, f_stall, f_fetches,
-    ] + work_fams
+    ] + work_fams + spill_fams
 
 
 # ------------------------------------------------------- daemon scrape
@@ -308,8 +337,20 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     stall = 0.0
     hbm = 0
     work: Dict[str, int] = {}
+    spill_last: Dict[str, object] = {}
     for e in events:
         ev = e.get("event")
+        if ev == "spill":
+            # cumulative v9 counters: the NEWEST record is the total —
+            # the event fallback so a live/crashed tiered run's stream
+            # still exports ptt_spill_* (result stats only exist after
+            # a clean run end)
+            for k in (
+                "keys_evicted", "rows_evicted", "bytes_raw",
+                "bytes_comp", "transfer_s", "misses_resolved",
+            ):
+                if isinstance(e.get(k), (int, float)):
+                    spill_last[f"spill_{k}"] = e[k]
         if ev == "fuse":
             # per-dispatch work deltas (v7): the event-sum fallback so
             # a crashed run's stream still exports ptt_work_* families
@@ -359,6 +400,8 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     stats.setdefault("hbm_recovered", hbm or None)
     for k, v in work.items():
         stats.setdefault(k, v or None)
+    for k, v in spill_last.items():
+        stats.setdefault(k, v)
 
     fams = _engine_families(stats, snap)
 

@@ -178,7 +178,8 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
     out: Dict[str, object] = {
         k: v
         for k, v in stats.items()
-        if k.startswith(("fpset_", "ckpt_", "work_"))
+        if k.startswith(("fpset_", "ckpt_", "work_", "spill_"))
+        or k == "hbm_budget"
     }
     for k in (
         "distinct_states", "diameter", "wall_s", "states_per_sec",

@@ -84,10 +84,20 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # online-adaptation controller emits one ``tune`` record per knob
 # adjustment (knob, value, prev, reason) at the dispatch boundary
 # where it applied (tune/online.py; docs/tuning.md).
+# v9 (round 16, the tiered state store): run headers carry
+# ``hbm_budget`` — the device-memory byte budget the run was tiered
+# under (null on untiered runs; REQUIRED at v9 like profile_sig so
+# spill trajectories always split cleanly) — and tiered engines emit
+# one ``spill`` record per eviction/spill boundary: the tier written,
+# keys/rows evicted, raw vs compressed bytes, transfer seconds, and
+# misses resolved — ALL CUMULATIVE per run, so the validator can
+# cross-check that per-level spill bytes are monotone-cumulative
+# (a spill event whose counters go backwards is a torn writer or a
+# re-based store; docs/memory.md).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -146,11 +156,26 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("run_header", "profile_sig"): 8,
     ("tune", "knob"): 8,
     ("tune", "value"): 8,
+    # v9 (round 16): the tiered-store budget on every run header
+    # (null on untiered runs) and the cumulative ``spill`` record —
+    # gated so every committed v8-and-older stream stays clean.
+    ("run_header", "hbm_budget"): 9,
+    ("spill", "tier"): 9,
+    ("spill", "keys_evicted"): 9,
+    ("spill", "rows_evicted"): 9,
+    ("spill", "bytes_raw"): 9,
+    ("spill", "bytes_comp"): 9,
+    ("spill", "transfer_s"): 9,
+    ("spill", "misses_resolved"): 9,
 }
 EVENTS: Dict[str, Tuple[str, ...]] = {
     # run lifecycle (v8 adds profile_sig — the tuned profile that
-    # shaped the run's knobs, null on untuned runs)
-    "run_header": ("engine", "visited_impl", "config_sig", "profile_sig"),
+    # shaped the run's knobs, null on untuned runs; v9 adds
+    # hbm_budget — the tiered-store byte budget, null when untiered)
+    "run_header": (
+        "engine", "visited_impl", "config_sig", "profile_sig",
+        "hbm_budget",
+    ),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress
     "level": (
@@ -182,6 +207,16 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # adjustment the dispatch-boundary controller applied — an
     # adapted run is never silently different from its profile
     "tune": ("knob", "value"),
+    # tiered state store (r16, store/): one record per eviction/spill
+    # boundary with CUMULATIVE per-run counters — the tier the data
+    # landed in (ram | ram+disk), keys/rows evicted, raw vs compressed
+    # bytes, transfer seconds (D2H gather + encode + durable write),
+    # and cold-tier misses resolved.  Cumulative so the validator's
+    # monotone cross-check catches torn/re-based writers.
+    "spill": (
+        "tier", "keys_evicted", "rows_evicted", "bytes_raw",
+        "bytes_comp", "transfer_s", "misses_resolved",
+    ),
     # survivability (r9: ``retries`` is the frame writer's
     # transient-failure retry count — the ckpt_retries breadcrumb)
     "ckpt_frame": (

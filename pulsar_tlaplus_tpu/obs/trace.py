@@ -183,6 +183,13 @@ def _engine_track_events(
     ckpt stalls, sweep chunks, flush/compact counters, result)."""
     out: List[dict] = []
     prev_t: Optional[float] = None
+    # spill transfers render as async spans on their OWN track (r16):
+    # the cumulative transfer_s delta is the span width, ending at the
+    # boundary that joined the async work — overlap with the level
+    # spans above is exactly the overlap the store measures
+    spill_tid = tid * 100
+    prev_spill_s = 0.0
+    n_spill = 0
     for e in events:
         ev = e.get("event")
         t = e.get("t")
@@ -299,6 +306,37 @@ def _engine_track_events(
                 out.append(
                     _counter(pid, tid, "fused work units", t + off, vals)
                 )
+        elif ev == "spill":
+            dur = max(
+                float(e.get("transfer_s", 0.0) or 0.0) - prev_spill_s,
+                0.0,
+            )
+            prev_spill_s = float(e.get("transfer_s", 0.0) or 0.0)
+            if n_spill == 0:
+                out.append(
+                    _meta(
+                        pid, spill_tid, "spill transfers",
+                        "thread_name",
+                    )
+                )
+            n_spill += 1
+            out.append(
+                _span(
+                    pid, spill_tid,
+                    f"spill -> {e.get('tier', '?')}",
+                    t - dur + off, dur,
+                    args={
+                        k: e[k]
+                        for k in (
+                            "keys_evicted", "rows_evicted",
+                            "bytes_raw", "bytes_comp",
+                            "misses_resolved", "evictions", "level",
+                        )
+                        if k in e
+                    },
+                    cat="ptt.spill",
+                )
+            )
         elif ev == "hbm_recovery":
             out.append(
                 _instant(

@@ -1,0 +1,34 @@
+"""Tiered state store (round 16): break the HBM ceiling.
+
+The device engine's visited keys, packed rows, and parent/lane trace
+logs historically had to live entirely in HBM, which is what made every
+capacity-bounded run die at ``stop_reason: "max_states"``.  This
+package is the TLC ``states/`` disk tier reborn for the TPU split
+(PAPERS.md, "Compression and Sieve", arXiv:1208.5542):
+
+- :mod:`~pulsar_tlaplus_tpu.store.budget` — the ``--hbm-budget`` /
+  ``PTT_HBM_BUDGET`` knob and the byte arithmetic behind it;
+- :mod:`~pulsar_tlaplus_tpu.store.sieve` — the device-side ops
+  (generation tagging, evict-cold-runs extraction, miss-verdict
+  unflagging) that keep confirmed-visited keys from ever crossing the
+  slow link;
+- :mod:`~pulsar_tlaplus_tpu.store.compress` — delta-encoded sorted key
+  planes and packed row payloads for what must cross;
+- :mod:`~pulsar_tlaplus_tpu.store.tiers` — the host-side
+  :class:`TieredStore`: cold key runs + row/log segments in host RAM
+  (and on disk under the run's state dir), async eviction transfers,
+  batched miss resolution, and the spill manifest checkpoint frames
+  embed.
+
+See docs/memory.md for the full architecture.
+"""
+
+from pulsar_tlaplus_tpu.store.budget import (  # noqa: F401
+    parse_budget,
+    resolve_budget,
+)
+from pulsar_tlaplus_tpu.store.tiers import (  # noqa: F401
+    SpillStats,
+    TieredStore,
+    cleanup_stale_spill,
+)

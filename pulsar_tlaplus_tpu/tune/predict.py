@@ -49,6 +49,17 @@ from pulsar_tlaplus_tpu.obs import attribution
 # local dispatch on the CPU mesh
 DEFAULT_DISPATCH_S = {"cpu": 2e-4, "tpu": 0.13}
 
+# link byte rate for the tiered-store spill term when no calibration
+# measured it (``calibration.json`` key ``link_bytes_per_s``): the
+# tunnel moves ~20 MB/s (BASELINE.md); host RAM on the CPU mesh is
+# effectively memcpy speed
+DEFAULT_LINK_BYTES_S = {"cpu": 2e9, "tpu": 20e6}
+
+# nominal delta+zlib ratio when the reference ran uncompressed (the
+# measured producer_on ratio is ~0.35; used only to price a
+# spill_compress=True candidate against an uncompressed reference)
+_NOMINAL_SPILL_RATIO = 0.4
+
 # default probe schedule constants mirrored from ops/fpset.py (not
 # imported: predict must stay importable without jax)
 _DENSE_DEFAULT = 4
@@ -163,12 +174,40 @@ def predict_candidate(
         cal.get("rtt_s")
         or DEFAULT_DISPATCH_S.get(backend, DEFAULT_DISPATCH_S["tpu"])
     )
-    overhead = (disp + extra_syncs) * per_disp
+    # tiered-store link term (r16): a budgeted workload's spilled
+    # bytes cross the slow link — price them at the measured byte
+    # rate, and the batched miss resolutions at one sync each.  The
+    # reference run's spill traffic is knob-invariant (evictions are
+    # state-determined at a fixed budget); only the encoding and the
+    # batch width move across candidates.
+    spill_s = 0.0
+    raw = float(ref.get("spill_bytes_raw") or 0)
+    if raw > 0:
+        rate = float(
+            cal.get("link_bytes_per_s")
+            or DEFAULT_LINK_BYTES_S.get(
+                backend, DEFAULT_LINK_BYTES_S["tpu"]
+            )
+        )
+        comp_ref = float(ref.get("spill_bytes_comp") or raw)
+        ratio = comp_ref / raw if comp_ref < raw else _NOMINAL_SPILL_RATIO
+        compress = cand.get("spill_compress")
+        if compress is None:
+            compress = bool(ref.get("spill_compress", True))
+        bytes_cross = raw * ratio if compress else raw
+        spill_s = bytes_cross / max(rate, 1.0)
+        mb = int(
+            cand.get("miss_batch") or ref.get("miss_batch") or (1 << 15)
+        )
+        misses = float(ref.get("spill_misses_resolved") or 0)
+        spill_s += (misses / max(mb, 1)) * per_disp
+    overhead = (disp + extra_syncs) * per_disp + spill_s
     return {
         "est_s": round(est + overhead, 6),
         "est_work": work,
         "dispatches": int(disp),
         "overhead_s": round(overhead, 6),
+        "spill_s": round(spill_s, 6),
     }
 
 
@@ -203,6 +242,14 @@ def reference_of(ck, result) -> Dict[str, object]:
         "avg_probe_rounds": float(
             stats.get("fpset_avg_probe_rounds") or 1.0
         ),
+        # tiered-store reference signals (r16): zero/absent untiered
+        "spill_bytes_raw": int(stats.get("spill_bytes_raw") or 0),
+        "spill_bytes_comp": int(stats.get("spill_bytes_comp") or 0),
+        "spill_misses_resolved": int(
+            stats.get("spill_misses_resolved") or 0
+        ),
+        "spill_compress": bool(getattr(ck, "spill_compress", True)),
+        "miss_batch": int(getattr(ck, "miss_batch", 1 << 15)),
     }
 
 

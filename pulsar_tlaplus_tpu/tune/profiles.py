@@ -55,7 +55,7 @@ _SCALAR = (int, float, bool, str, type(None))
 # validator enforces the ranges BEFORE any knob reaches a ctor
 _POSITIVE_INT_KNOBS = (
     "sub_batch", "flush_factor", "group", "fuse_group",
-    "fpset_dense_rounds", "sweep_group",
+    "fpset_dense_rounds", "sweep_group", "miss_batch",
 )
 _COMPACT_IMPLS = ("logshift", "sort")
 
@@ -101,16 +101,21 @@ def profile_key(
     invariants: Tuple[str, ...],
     engine: str = "device_bfs",
     backend: Optional[str] = None,
+    tiered: bool = False,
 ) -> str:
     """The profile's config-signature key: engine + model (spec +
     constant bindings) + invariant set + backend.  Capacity budgets
     (``max_states``) are deliberately excluded — they scale the run,
-    not the schedule shape — and every knob being tuned obviously is
-    too."""
+    not the schedule shape.  The tiered-store REGIME (r16) is folded
+    in when active: a budgeted run's winning knobs are chosen under
+    spill pressure and must never auto-resolve for the all-resident
+    regime (or vice versa) — appended conditionally so every existing
+    untiered key stands."""
     if backend is None:
         backend = default_backend()
     blob = repr(
         (engine, model_sig(model), tuple(invariants), backend)
+        + (("tiered",) if tiered else ())
     )
     return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
@@ -236,6 +241,20 @@ def validate(profile, path: str = "<profile>") -> List[str]:
             errs.append(
                 f"{path}: knob adapt must be a boolean (got {val!r})"
             )
+        elif k == "spill_compress" and not isinstance(val, bool):
+            errs.append(
+                f"{path}: knob spill_compress must be a boolean "
+                f"(got {val!r})"
+            )
+        elif k == "hbm_headroom" and (
+            isinstance(val, bool)
+            or not isinstance(val, (int, float))
+            or not (0.0 <= float(val) < 1.0)
+        ):
+            errs.append(
+                f"{path}: knob hbm_headroom must be a fraction in "
+                f"[0, 1) (got {val!r})"
+            )
     return errs
 
 
@@ -299,6 +318,7 @@ def resolve(
     model,
     invariants: Tuple[str, ...],
     engine: str = "device_bfs",
+    tiered: bool = False,
 ) -> Optional[dict]:
     """Engine-side resolution: ``None`` -> no profile; ``"auto"`` ->
     look up by config signature; a dict -> validate + sig/engine
@@ -307,7 +327,10 @@ def resolve(
     file loader); a path string -> load that file, same checks."""
     if profile is None:
         return None
-    key = profile_key(model=model, invariants=invariants, engine=engine)
+    key = profile_key(
+        model=model, invariants=invariants, engine=engine,
+        tiered=tiered,
+    )
     if isinstance(profile, dict):
         errs = validate(profile)
         if errs:
@@ -330,7 +353,10 @@ def resolve(
     except (OSError, json.JSONDecodeError) as e:
         _warn(f"{profile} is unreadable ({e}); using defaults")
         return None
-    return resolve(prof, model=model, invariants=invariants, engine=engine)
+    return resolve(
+        prof, model=model, invariants=invariants, engine=engine,
+        tiered=tiered,
+    )
 
 
 def knobs_for(profile: Optional[dict], engine: str) -> Dict:

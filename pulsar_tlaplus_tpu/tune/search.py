@@ -28,6 +28,7 @@ from pulsar_tlaplus_tpu.tune import space as tune_space
 _CTOR_KNOBS = (
     "sub_batch", "flush_factor", "group", "fuse_group",
     "fpset_dense_rounds", "fpset_stages", "compact_impl",
+    "hbm_headroom", "spill_compress", "miss_batch",
 )
 
 
@@ -88,9 +89,16 @@ def tune_device(
     )
     cal = calibration or attribution.default_calibration(ref["backend"])
 
-    # ---- predict stage: rank the whole space, keep top-K
+    # ---- predict stage: rank the whole space, keep top-K.  Budgeted
+    # (tiered-store) workloads additionally search the spill knobs —
+    # predict prices their link-crossing bytes at the calibration's
+    # byte rate (r16)
     cands = tune_space.candidates(
-        model, base_sub_batch=ref["sub_batch"], limit=candidate_limit
+        model, base_sub_batch=ref["sub_batch"], limit=candidate_limit,
+        # the reference checker already resolved the budget (ctor arg
+        # OR the PTT_HBM_BUDGET env var) — search the spill knobs
+        # whenever the measured runs actually spill
+        spill=getattr(ck, "tiered", False),
     )
     ranked = tune_predict.rank(cands, ref, cal)
     by_key = {
@@ -173,6 +181,7 @@ def tune_device(
     sig = tune_profiles.profile_key(
         model=model, invariants=tuple(ck.invariant_names),
         engine="device_bfs", backend=ref["backend"],
+        tiered=getattr(ck, "tiered", False),
     )
     knobs = dict(winner)
     if adapt:

@@ -19,6 +19,14 @@ order; pinned by the differential tests in tests/test_tune.py):
 - ``fpset_dense_rounds``  full-width probe rounds before the staged
                       pending-compaction shrinks the batch
 - ``compact_impl``    stream-compaction materialization (logshift|sort)
+
+Tiered-store knobs (round 16, searched only for budgeted workloads —
+``candidates(spill=True)``; they are no-ops untiered and would only
+dilute the measure stage there):
+
+- ``hbm_headroom``    budget fraction reserved against transients
+- ``spill_compress``  delta+zlib the cold planes (link bytes vs CPU)
+- ``miss_batch``      sieved keys per cold-lookup batch
 """
 
 from __future__ import annotations
@@ -56,6 +64,21 @@ DEVICE_KNOBS: Tuple[Knob, ...] = (
     # profiles (PROFILE_KNOBS below).
 )
 
+# tiered-store knobs (r16): searched only when the workload is
+# budgeted (hbm_budget set) — predict prices the link-crossing bytes
+# at the calibration's measured byte rate (tune/predict.py)
+SPILL_KNOBS: Tuple[Knob, ...] = (
+    Knob("hbm_headroom", (None, 0.05, 0.2), "budget headroom fraction"),
+    Knob(
+        "spill_compress", (None, False),
+        "delta+zlib cold planes (None = on)",
+    ),
+    Knob(
+        "miss_batch", (None, 1 << 14, 1 << 16),
+        "sieved keys per cold-lookup batch",
+    ),
+)
+
 # liveness-engine knobs carried by profiles (loaded by
 # LivenessChecker; offline search over them is future work — the
 # device engine dominates exploration wall)
@@ -69,6 +92,7 @@ PROFILE_KNOBS: Dict[str, Tuple[str, ...]] = {
     "device_bfs": (
         "sub_batch", "flush_factor", "group", "fuse_group",
         "fpset_dense_rounds", "fpset_stages", "compact_impl", "adapt",
+        "hbm_headroom", "spill_compress", "miss_batch",
     ),
     "liveness": ("sweep_group", "compact_impl", "adapt"),
 }
@@ -94,13 +118,18 @@ def candidates(
     base_sub_batch: int = 8192,
     knobs: Iterable[Knob] = DEVICE_KNOBS,
     limit: Optional[int] = None,
+    spill: bool = False,
 ) -> List[Dict]:
     """The cartesian product of the knob space, validity-pruned, as a
     list of sparse knob dicts (``None`` entries — engine defaults —
     are dropped; the all-default candidate comes first and IS the
     baseline the tuner must beat).  ``sub_batch`` multipliers resolve
-    against ``base_sub_batch`` rounded to a power of two."""
+    against ``base_sub_batch`` rounded to a power of two.
+    ``spill=True`` (budgeted workloads) adds the tiered-store knobs
+    to the product."""
     knobs = tuple(knobs)
+    if spill:
+        knobs = knobs + SPILL_KNOBS
     out: List[Dict] = []
     for combo in itertools.product(*(k.values for k in knobs)):
         cand: Dict = {}

@@ -40,7 +40,13 @@ the new ``attribution`` record the per-stage work totals; r15: v8
 run headers carry ``profile_sig`` — the tuned profile that shaped
 the run's knobs, null on untuned runs — and the online-adaptation
 controller emits ``tune`` records (knob, value) at the dispatch
-boundaries where adjustments applied — all FIELD_SINCE-gated so
+boundaries where adjustments applied; r16: v9 run headers carry
+``hbm_budget`` — the tiered-store byte budget, null on untiered runs
+— and tiered engines emit ``spill`` records whose counters
+(keys/rows evicted, raw/compressed bytes, transfer seconds, misses
+resolved) are CUMULATIVE per run: the validator cross-checks that
+per-level spill bytes are monotone-cumulative, so a torn or re-based
+spill writer fails loudly — all FIELD_SINCE-gated so
 older streams stay clean).  ``--trace``
 validates an exported Perfetto trace file's event structure instead
 (obs/trace.py); ``--ledger`` validates cross-run regression ledger
@@ -52,7 +58,9 @@ headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
 ``ckpt_retries``, >= 5 additionally ``compact_impl``, >= 6
 additionally ``fuse`` + ``dispatches_per_level``, >= 7 additionally
-the ``work_*`` unit totals (r14 attribution).
+the ``work_*`` unit totals (r14 attribution), >= 8 additionally
+the tiered-store keys (``hbm_budget``, ``spill_bytes_per_state``,
+``spill_overlap_ratio`` — null on untiered runs, keys required).
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -102,6 +110,11 @@ BENCH_KEYS_V6 = BENCH_KEYS_V5 + ("fuse", "dispatches_per_level")
 BENCH_KEYS_V7 = BENCH_KEYS_V6 + (
     "work_expand_rows", "work_probe_lanes", "work_compact_elems",
     "work_append_rows", "work_groups",
+)
+# v8 (r16): the tiered-store budget + spill economy signals (null on
+# untiered runs; the keys themselves are required)
+BENCH_KEYS_V8 = BENCH_KEYS_V7 + (
+    "hbm_budget", "spill_bytes_per_state", "spill_overlap_ratio",
 )
 
 
@@ -155,12 +168,21 @@ def _check_fused_levels(path: str, runs: dict) -> List[str]:
     return errors
 
 
+# the spill record's cumulative counters (v9): each must be
+# monotone non-decreasing per run_id
+SPILL_CUMULATIVE = (
+    "keys_evicted", "rows_evicted", "bytes_raw", "bytes_comp",
+    "transfer_s", "misses_resolved",
+)
+
+
 def validate_stream(path: str) -> List[str]:
     """All schema violations in one stream (empty list = clean)."""
     errors: List[str] = []
     last_t: dict = {}
     last_seq: dict = {}
     fused_runs: dict = {}
+    last_spill: dict = {}
     n = 0
     try:
         f = open(path)
@@ -235,6 +257,24 @@ def validate_stream(path: str) -> List[str]:
                     errors.append(
                         f"{path}:{i}: {rec['event']} missing {miss}"
                     )
+            if rec["event"] == "spill" and isinstance(
+                rec.get("v"), int
+            ) and rec["v"] >= 9:
+                # v9 cross-check: spill counters are CUMULATIVE per
+                # run — a record whose bytes/keys go backwards is a
+                # torn writer or a silently re-based store
+                prev = last_spill.setdefault(rec["run_id"], {})
+                for k in SPILL_CUMULATIVE:
+                    cur = rec.get(k)
+                    if not isinstance(cur, (int, float)):
+                        continue
+                    if cur < prev.get(k, float("-inf")):
+                        errors.append(
+                            f"{path}:{i}: spill.{k} went backwards "
+                            f"for run {rec['run_id']} ({cur} < "
+                            f"{prev[k]} — cumulative contract)"
+                        )
+                    prev[k] = cur
             # collect per-run material for the v6 fused-run
             # cross-check (boundary level records vs result sizes)
             run = fused_runs.setdefault(
@@ -279,7 +319,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 7:
+    if schema >= 8:
+        required = BENCH_KEYS_V8
+    elif schema >= 7:
         required = BENCH_KEYS_V7
     elif schema >= 6:
         required = BENCH_KEYS_V6

@@ -33,6 +33,14 @@ def main():
     ap.add_argument("--sweep-chunk", type=int, default=1 << 12)
     ap.add_argument("--frontier-chunk", type=int, default=2048)
     ap.add_argument(
+        "--hbm-budget", dest="hbm_budget", default=None,
+        help="tiered-store byte budget (device engine; 'min+N' = the "
+        "engine's initial-tier minimum plus N bytes, resolved here so "
+        "drills stay shape-independent)",
+    )
+    ap.add_argument("--sub-batch", type=int, default=2048)
+    ap.add_argument("--visited-cap", type=int, default=1 << 16)
+    ap.add_argument(
         "--config", default="shipped",
         choices=["shipped", "producer_on", "consumer_on"],
         help="shipped = the published 45k oracle; producer_on / "
@@ -101,9 +109,28 @@ def main():
     if args.engine == "device":
         from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
 
+        hbm_budget = args.hbm_budget
+        if hbm_budget and hbm_budget.startswith("min+"):
+            # resolve "minimum viable + N" against a throwaway probe so
+            # the drill pins a TIGHT budget without hard-coding bytes
+            # (the shared helpers.tight_hbm_budget recipe)
+            from tests.helpers import tight_hbm_budget
+
+            hbm_budget = tight_hbm_budget(
+                lambda b: DeviceChecker(
+                    m, invariants=inv, sub_batch=args.sub_batch,
+                    visited_cap=args.visited_cap,
+                    frontier_cap=args.visited_cap // 2,
+                    max_states=args.max_states, hbm_budget=b,
+                ),
+                slack=int(hbm_budget[4:]),
+            )
         ck = DeviceChecker(
-            m, invariants=inv, sub_batch=2048, visited_cap=1 << 16,
-            frontier_cap=1 << 15, max_states=args.max_states,
+            m, invariants=inv, sub_batch=args.sub_batch,
+            visited_cap=args.visited_cap,
+            frontier_cap=args.visited_cap // 2,
+            max_states=args.max_states,
+            hbm_budget=hbm_budget,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.every,
             telemetry=args.telemetry,

@@ -106,6 +106,25 @@ def oracle_sample(c, n_states=150, levels=8, seed=0):
     return rng.sample(pool, min(n_states, len(pool)))
 
 
+def tight_hbm_budget(checker_ctor, slack=4096):
+    """A budget just above a checker shape's initial-tier minimum —
+    tiers pinned at their smallest, so a tiered run MUST spill.
+    ``checker_ctor(hbm_budget)`` builds a throwaway probe checker with
+    the workload's exact shape knobs; the 0.9 divisor mirrors the
+    engine's default ``hbm_headroom=0.1``.  One definition so every
+    spill drill/test stays in lockstep with the engine's byte
+    arithmetic (tests/test_store.py, tests/test_subscription.py,
+    tests/_survivable_run.py)."""
+    probe = checker_ctor("1G")
+    return (
+        int(
+            probe._device_bytes_est(probe.TCAP, probe.LCAP, probe.PCAP)
+            / (1.0 - probe.hbm_headroom)
+        )
+        + slack
+    )
+
+
 # Small configurations exercising distinct semantic corners (cheap enough
 # for exhaustive engine-vs-oracle runs on the CPU backend).
 SMALL_CONFIGS = {

@@ -18,7 +18,7 @@ from pulsar_tlaplus_tpu.models.subscription import (
     SubscriptionConstants,
     SubscriptionModel,
 )
-from tests.helpers import needs_shard_map
+from tests.helpers import needs_shard_map, tight_hbm_budget
 
 SPEC_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -160,6 +160,100 @@ def test_liveness_termination():
     assert r.holds, r.reason
     r2 = LivenessChecker(m, goal="Termination", fairness="none").run()
     assert not r2.holds  # raw Spec admits infinite stuttering at Init
+
+
+# Subscription becomes the FOURTH exact-parity pinned workload beside
+# compaction (45,198 / 253,361), bookkeeper (297 / 2,257), and
+# georeplication (6,400): the shipped binding (specs/subscription.cfg —
+# MessageLimit 3, MaxCrashTimes 2) pins 2,272 states / diameter 24 on
+# the interpreter, the host engine, AND the device engine.  Derived
+# from the interpreter BFS on specs/subscription.tla; the tiny binding
+# (122 / 16) re-derives inline as the cheap cross-check.  It is also
+# the round-16 SPILL-PARITY differential workload: the same device run
+# under a budget that forces key eviction + row/log spill must be
+# state-for-state identical (tests below; docs/memory.md).
+
+SHIPPED_STATES, SHIPPED_DIAMETER = 2272, 24  # specs/subscription.cfg
+TINY_STATES, TINY_DIAMETER = 122, 16
+
+
+def test_shipped_cfg_pinned_oracle_count(module):
+    """Interpreter, host engine, and device engine all reproduce the
+    pinned shipped-binding count — the exact-parity contract the
+    other three registry workloads already carry."""
+    c = CONFIGS["shipped"]
+    ri = InterpChecker(
+        spec_for(module, c),
+        invariants=("TypeOK", "NoLostMessage", "AckedWasProcessed"),
+    ).run()
+    assert (ri.distinct_states, ri.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    rh = Checker(SubscriptionModel(c), frontier_chunk=256).run()
+    assert (rh.distinct_states, rh.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    rd = DeviceChecker(
+        SubscriptionModel(c), sub_batch=256, visited_cap=1 << 12,
+        frontier_cap=1 << 10,
+    ).run()
+    assert (rd.distinct_states, rd.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    assert rd.violation is None and not rd.deadlock
+    ti = InterpChecker(
+        spec_for(module, CONFIGS["tiny"]),
+        invariants=("TypeOK", "NoLostMessage", "AckedWasProcessed"),
+    ).run()
+    assert (ti.distinct_states, ti.diameter) == (
+        TINY_STATES, TINY_DIAMETER,
+    )
+
+
+def test_shipped_cfg_spill_parity_differential():
+    """The round-16 spill-parity workload: the shipped subscription
+    run under a budget that forces eviction + row/log spill is
+    state-for-state identical to the untiered run — level sizes,
+    packed rows, and parent/lane logs (merged cold+device view)."""
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    c = CONFIGS["shipped"]
+    kw = dict(
+        invariants=(), check_deadlock=False, sub_batch=128,
+        visited_cap=1 << 9, frontier_cap=1 << 9,
+    )
+    ck_u = DeviceChecker(SubscriptionModel(c), **kw)
+    r_u = ck_u.run()
+    assert (r_u.distinct_states, r_u.diameter) == (
+        SHIPPED_STATES, SHIPPED_DIAMETER,
+    )
+    budget = tight_hbm_budget(
+        lambda b: DeviceChecker(SubscriptionModel(c), hbm_budget=b, **kw)
+    )
+    ck_t = DeviceChecker(SubscriptionModel(c), hbm_budget=budget, **kw)
+    r_t = ck_t.run()
+    assert r_t.distinct_states == r_u.distinct_states
+    assert r_t.level_sizes == r_u.level_sizes
+    assert ck_t.last_stats["spill_evictions"] >= 1
+    assert ck_t.last_stats["spill_rows_evicted"] > 0
+    nv, W = r_u.distinct_states, ck_u.W
+    base = ck_t._last_rb["row_base"]
+    cp, cl = ck_t.tstore.fetch_logs(0, base)
+    pt = np.concatenate(
+        [cp, np.asarray(ck_t.last_bufs["parent"][: nv - base])]
+    )
+    lt = np.concatenate(
+        [cl, np.asarray(ck_t.last_bufs["lane"][: nv - base])]
+    )
+    assert (np.asarray(ck_u.last_bufs["parent"][:nv]) == pt).all()
+    assert (np.asarray(ck_u.last_bufs["lane"][:nv]) == lt).all()
+    cold = ck_t.tstore.fetch_rows(0, base, W)
+    rt = np.concatenate(
+        [cold, np.asarray(ck_t.last_bufs["rows"][: (nv - base) * W])]
+    )
+    assert (np.asarray(ck_u.last_bufs["rows"][: nv * W]) == rt).all()
 
 
 def test_simulation_finds_duplicate():

@@ -40,6 +40,7 @@ from pulsar_tlaplus_tpu.models.bookkeeper import (
 )
 from pulsar_tlaplus_tpu.models.compaction import CompactionModel
 from pulsar_tlaplus_tpu.obs import ledger
+from pulsar_tlaplus_tpu.obs import telemetry
 from pulsar_tlaplus_tpu.ref import pyeval as pe
 from pulsar_tlaplus_tpu.tune import online, predict, profiles, space
 from tests.helpers import SMALL_CONFIGS, assert_valid_counterexample
@@ -296,7 +297,8 @@ def test_engine_resolves_profile_and_explicit_knobs_win(tmp_path):
     assert (r.distinct_states, r.diameter) == (297, 14)  # pinned
     hd = [json.loads(x) for x in open(stream)][0]
     assert hd["event"] == "run_header"
-    assert hd["profile_sig"] == sig and hd["v"] == 8
+    assert hd["profile_sig"] == sig
+    assert hd["v"] == telemetry.SCHEMA_VERSION
 
     # explicit ctor knobs beat the profile, sig still attributes
     ck2 = DeviceChecker(
@@ -431,7 +433,8 @@ def test_online_adaptation_state_for_state_with_tune_events(tmp_path):
     # that never probes deep), and every move respected its bounds
     assert tunes
     for e in tunes:
-        assert e["v"] == 8 and e["knob"] in (
+        assert e["v"] == telemetry.SCHEMA_VERSION
+        assert e["knob"] in (
             "fuse_cap", "fpset_dense_rounds",
         )
         if e["knob"] == "fuse_cap":
