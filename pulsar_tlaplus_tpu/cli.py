@@ -597,7 +597,12 @@ def _socket_of(args) -> str:
 def _service_client(args):
     from pulsar_tlaplus_tpu.service.client import ServiceClient
 
-    return ServiceClient(_socket_of(args), timeout=args.timeout)
+    return ServiceClient(
+        _socket_of(args),
+        timeout=args.timeout,
+        token=getattr(args, "token", None),
+        retries=getattr(args, "retries", 4),
+    )
 
 
 def _client_die(msg: str):
@@ -607,6 +612,27 @@ def _client_die(msg: str):
     down" from "the spec is broken"."""
     print(f"tpu-tlc: {msg}", file=sys.stderr)
     sys.exit(2)
+
+
+def _client_fail(op: str, e) -> None:
+    """Map a client-side failure to the exit-code contract on EVERY
+    subcommand: 4 = auth rejected, 5 = over quota / load shed, 2 =
+    transport/daemon failure — so `status` with an expired token
+    reads "fix my token", not "the daemon is down"."""
+    from pulsar_tlaplus_tpu.service.client import (
+        AdmissionRejected,
+        AuthError,
+    )
+
+    if isinstance(e, AuthError):
+        print(f"tpu-tlc: {op} rejected (auth): {e}", file=sys.stderr)
+        sys.exit(4)
+    if isinstance(e, AdmissionRejected):
+        print(
+            f"tpu-tlc: {op} rejected ({e.code}): {e}", file=sys.stderr
+        )
+        sys.exit(5)
+    _client_die(f"{op} failed: {e}")
 
 
 def _print_job_line(j: dict) -> None:
@@ -698,17 +724,29 @@ def _cmd_serve(args) -> int:
         specs=tuple(args.spec or ()),
         prewarm_tiers=not args.no_tiers,
         profiles="none" if args.no_profiles else "auto",
+        tcp=args.tcp or "",
+        tokens_path=args.tokens or "",
+        queue_cap=args.queue_cap,
+        tenant_max_queued=args.tenant_max_queued,
+        tenant_max_running=args.tenant_max_running,
+        tenant_max_states=args.tenant_max_states,
     )
     try:
         daemon = ServiceDaemon(config, recover=args.recover, log=log)
-    except RuntimeError as e:  # another daemon holds the state dir
+    except (RuntimeError, ValueError) as e:  # lock held / bad tokens
         sys.exit(f"tpu-tlc: {e}")
     if not args.no_prewarm:
         daemon.prewarm()
-    daemon.start()
+    try:
+        daemon.start()
+    except OSError as e:  # TCP bind failure (port in use, EACCES)
+        daemon.shutdown()
+        sys.exit(f"tpu-tlc: cannot listen: {e}")
     daemon.install_signal_handlers()
     # the ready line goes to STDOUT so wrappers/tests can block on it
     print(f"serving on {config.socket_path}", flush=True)
+    if daemon.tcp_port is not None:
+        print(f"serving on tcp port {daemon.tcp_port}", flush=True)
     daemon.serve_forever(drain=args.drain)
     return 0
 
@@ -724,9 +762,16 @@ def _cmd_submit(args) -> int:
             invariants=args.invariant,
             max_states=args.maxstates,
             time_budget_s=args.time_budget,
+            priority=args.priority,
+            deadline_s=args.deadline_s,
+            submit_id=args.submit_id,
         )
     except (ServiceError, OSError) as e:
-        _client_die(f"submit failed: {e}")
+        # distinct exit codes for rejected-at-the-door (docs/
+        # service.md "Admission"): 4 = bad/missing token, 5 = over
+        # quota / load shed — a CI lane tells "fix my token" from
+        # "back off" from "the daemon is down" (2) without parsing
+        _client_fail("submit", e)
     print(jid)
     if args.watch:
         return _watch_stream(cl, jid, args.timeout)
@@ -755,7 +800,7 @@ def _cmd_status(args) -> int:
             for j in jobs:
                 _print_job_line(j)
     except (ServiceError, OSError) as e:
-        _client_die(f"status failed: {e}")
+        _client_fail("status", e)
     return 0
 
 
@@ -800,7 +845,7 @@ def _watch_stream(cl, job_id: str, timeout: float) -> int:
             elif "error" in msg or not msg.get("ok", True):
                 _client_die(f"watch: {msg.get('error')}")
     except (ServiceError, OSError) as e:
-        _client_die(f"watch failed: {e}")
+        _client_fail("watch", e)
     return 2  # stream ended without a done record
 
 
@@ -815,7 +860,7 @@ def _cmd_cancel(args) -> int:
     try:
         state = cl.cancel(args.job_id)
     except (ServiceError, OSError) as e:
-        _client_die(f"cancel failed: {e}")
+        _client_fail("cancel", e)
     print(f"{args.job_id}: {state}")
     return 0
 
@@ -885,7 +930,7 @@ def _cmd_metrics(args) -> int:
     try:
         sys.stdout.write(cl.metrics())
     except (ServiceError, OSError) as e:
-        _client_die(f"metrics failed: {e}")
+        _client_fail("metrics", e)
     return 0
 
 
@@ -913,7 +958,7 @@ def _cmd_top(args) -> int:
             try:
                 text = frame()
             except (ServiceError, OSError) as e:
-                _client_die(f"top failed: {e}")
+                _client_fail("top", e)
             if args.once:
                 print(text)
                 return 0
@@ -1169,7 +1214,19 @@ def _add_client_args(sp) -> None:
     )
     sp.add_argument(
         "--socket", default=None,
-        help="daemon socket path (overrides --state-dir)",
+        help="daemon address (overrides --state-dir): a unix socket "
+        "path, or tcp://HOST:PORT for the authenticated TCP "
+        "transport (pair with --token)",
+    )
+    sp.add_argument(
+        "--token", default=None,
+        help="bearer token for the TCP transport (serve --tokens; "
+        "the unix socket needs none)",
+    )
+    sp.add_argument(
+        "--retries", type=int, default=4,
+        help="transport retry budget (exponential backoff + jitter "
+        "on connect/transient failures; default 4)",
     )
     sp.add_argument(
         "--timeout", type=float, default=600.0,
@@ -1193,6 +1250,36 @@ def main(argv=None):
         "dirs; default ~/.ptt_serve)",
     )
     ps.add_argument("--socket", default=None, help="override socket path")
+    ps.add_argument(
+        "--tcp", default=None, metavar="HOST:PORT",
+        help="additionally listen on an authenticated TCP socket "
+        "(port 0 = ephemeral; REQUIRES --tokens; the unix socket "
+        "stays the no-auth localhost path — docs/service.md Security)",
+    )
+    ps.add_argument(
+        "--tokens", default=None, metavar="FILE",
+        help="tokens.json mapping bearer tokens to tenants "
+        "(validate with scripts/check_telemetry_schema.py --tokens)",
+    )
+    ps.add_argument(
+        "--queue-cap", type=int, default=64,
+        help="global cap on alive jobs; past it submits are SHED "
+        "with a typed capacity error (0 = unlimited; default 64)",
+    )
+    ps.add_argument(
+        "--tenant-max-queued", type=int, default=16,
+        help="per-tenant cap on queued jobs (0 = unlimited)",
+    )
+    ps.add_argument(
+        "--tenant-max-running", type=int, default=0,
+        help="per-tenant cap on jobs holding device slices "
+        "(running + suspended; 0 = unlimited)",
+    )
+    ps.add_argument(
+        "--tenant-max-states", type=int, default=0,
+        help="per-tenant cap on the aggregate max_states budget of "
+        "live jobs (0 = unlimited)",
+    )
     ps.add_argument(
         "--spec", action="append", default=None,
         help="registry spec to prewarm at startup (repeatable; "
@@ -1260,6 +1347,24 @@ def main(argv=None):
     pj.add_argument(
         "--time-budget", type=float, default=None, metavar="SEC",
         help="cumulative engine-wall budget across scheduling slices",
+    )
+    pj.add_argument(
+        "--priority", type=int, default=0, metavar="N",
+        help="scheduling priority (higher first; a waiting higher-"
+        "priority job preempts a running lower one at its next "
+        "level boundary; clamped to [-9, 9] at the daemon; "
+        "default 0)",
+    )
+    pj.add_argument(
+        "--deadline-s", type=float, default=None, metavar="SEC",
+        help="wall-clock deadline from submit; past it the job is "
+        "cancelled with stop_reason=deadline (exit 3, no verdict)",
+    )
+    pj.add_argument(
+        "--submit-id", default=None, metavar="ID",
+        help="idempotency key: a retried submit with the same id "
+        "returns the SAME job instead of enqueueing twice "
+        "(auto-generated when omitted)",
     )
     pj.add_argument(
         "--wait", action="store_true",

@@ -381,6 +381,8 @@ class Checker:
             # vs default trajectories uniformly
             profile_sig=None,
             hbm_budget=None,
+            # v10: tenant identity (None outside the daemon)
+            tenant=getattr(self, "tenant", None),
             wall_unix=round(time.time(), 3),
             max_states=self.max_states,
             invariants=list(self.invariant_names),

@@ -523,6 +523,7 @@ class DeviceChecker:
         self._hot_n = 0
         self._spill_sync_n = 0
         self._spill_emit_mark = 0
+        self._spill_degraded_emitted = False
         self._budget_overridden = False
         max_rows = (
             self.LCAP if rows_window == "frontier"
@@ -2505,6 +2506,7 @@ class DeviceChecker:
         self._hot_n = 0
         self._spill_sync_n = 0
         self._spill_emit_mark = 0
+        self._spill_degraded_emitted = False
         self._budget_overridden = False
         if self.tiered and not resume:
             # fresh runs own their spill dir; resume builds the store
@@ -2627,6 +2629,10 @@ class DeviceChecker:
             # tiered-store budget (r16, schema v9): None on untiered
             # runs — always present so spill trajectories split
             hbm_budget=self.hbm_budget,
+            # tenant identity (r17, schema v10): set per slice by the
+            # daemon scheduler, None on standalone runs — always
+            # present so per-tenant attribution never needs a join
+            tenant=getattr(self, "tenant", None),
         )
         rm = self._resume_meta
         if resume and rm:
@@ -3296,18 +3302,28 @@ class DeviceChecker:
 
     def _emit_spill(self, level: int) -> None:
         """One cumulative ``spill`` record per boundary with new spill
-        work (schema v9; the validator cross-checks monotonicity)."""
+        work (schema v9; the validator cross-checks monotonicity).
+        A degraded store (ENOSPC on the durable writer) flags its
+        record ``degraded`` and is emitted once even without fresh
+        spill work — the honest breadcrumb behind
+        ``stop_reason="spill_enospc"``."""
         if self.tstore is None:
             return
         s = self.tstore.stats
+        degraded = bool(self.tstore.degraded)
+        force = degraded and not self._spill_degraded_emitted
         mark = (
             s.evictions + s.keys_evicted + s.rows_evicted
             + s.misses_resolved
         )
-        if mark == self._spill_emit_mark or not self.tel.enabled:
+        if (
+            mark == self._spill_emit_mark and not force
+        ) or not self.tel.enabled:
             return
         self.tstore.flush()  # byte counts final; waits are measured
         self._spill_emit_mark = mark
+        if degraded:
+            self._spill_degraded_emitted = True
         self.tel.emit(
             "spill",
             tier=self._spill_tier_label(),
@@ -3321,6 +3337,7 @@ class DeviceChecker:
             miss_hits=int(s.miss_hits),
             evictions=int(s.evictions),
             hot_keys=int(self._hot_n),
+            **({"degraded": True} if degraded else {}),
         )
 
     def _run_recoverable(
@@ -3411,6 +3428,21 @@ class DeviceChecker:
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             if nf == 0:
                 return self._result(t0, nv, level_sizes, bufs)
+            if (
+                self.tstore is not None
+                and self.tstore.degraded
+            ):
+                # spill-tier ENOSPC (r17): the cold tiers lost
+                # durability mid-run.  Everything counted so far is
+                # exact (the in-RAM copies kept dedup correct), but
+                # the run can neither keep evicting nor write a
+                # resumable manifest — truncate honestly instead of
+                # surfacing the worker's raw crash
+                self._emit_spill(len(level_sizes))
+                return self._result(
+                    t0, nv, level_sizes, bufs, truncated=True,
+                    stop_reason="spill_enospc",
+                )
             if self._watcher is not None and self._watcher.requested:
                 # preemption-safe shutdown: SIGTERM/SIGINT landed since
                 # the last boundary — write a resumable frame and exit.
@@ -4098,6 +4130,11 @@ class DeviceChecker:
             # device rows unusable — keep the previous (older but
             # valid) frame rather than overwrite it with garbage
             return False
+        if self.tstore is not None and self.tstore.degraded:
+            # ENOSPC degraded the spill dir: a frame embedding a
+            # manifest over unwritten files would poison resume —
+            # keep the previous valid frame instead
+            return False
         t_stall = time.perf_counter()
         W = self.W
         # tiered frames save the device WINDOW only — everything older
@@ -4154,8 +4191,14 @@ class DeviceChecker:
             # a frame never references a half-written spill file)
             import json as _json
 
+            try:
+                man = self.tstore.manifest()
+            except ValueError:
+                # the join just latched ENOSPC degradation: the spill
+                # dir is incomplete, keep the previous valid frame
+                return False
             arrays["spill_manifest"] = np.frombuffer(
-                _json.dumps(self.tstore.manifest()).encode(),
+                _json.dumps(man).encode(),
                 dtype=np.uint8,
             )
             arrays["spill_hot_n"] = np.int64(self._hot_n)
@@ -4617,6 +4660,7 @@ class DeviceChecker:
                 spill_bytes_per_state=round(
                     sp.bytes_comp / max(nv, 1), 2
                 ),
+                spill_degraded=bool(self.tstore.degraded),
             )
             self._emit_spill(len(level_sizes))
             # run over: release the spill worker thread (the in-RAM

@@ -1071,6 +1071,8 @@ class LivenessChecker:
             # (the inner explorer's header carries its own)
             profile_sig=self.profile_sig,
             hbm_budget=getattr(self._checker, "hbm_budget", None),
+            # v10: tenant identity (None outside the daemon)
+            tenant=getattr(self, "tenant", None),
             wall_unix=round(time.time(), 3),
             goal=self.goal_name,
             fairness=self.fairness,

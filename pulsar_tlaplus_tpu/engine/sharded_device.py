@@ -1931,6 +1931,8 @@ class ShardedDeviceChecker:
             # yet; the field must still exist (schema v8 contract)
             profile_sig=None,
             hbm_budget=None,
+            # v10: tenant identity (None outside the daemon)
+            tenant=getattr(self, "tenant", None),
             wall_unix=round(time.time(), 3),
             max_states=self.SCAP,
             sub_batch=self.G,

@@ -219,6 +219,42 @@ def _engine_families(
     ] + work_fams + spill_fams
 
 
+def _admission_families(
+    admitted: Dict[str, float],
+    rejected: Dict[Tuple[str, str], float],
+    deduped: Dict[str, float],
+) -> List[Family]:
+    """The r17 admission-control families — admitted / rejected /
+    shed by reason, per tenant (the ISSUE's ``ptt_admission_*``
+    contract; load sheds are the ``reason="queue_full"`` slice of
+    rejected plus their own total for alerting)."""
+    f_adm = Family(
+        "ptt_admission_admitted_total", "counter",
+        "Submits admitted past quota checks, by tenant",
+    )
+    for tenant, n in sorted(admitted.items()):
+        f_adm.add(n, {"tenant": tenant})
+    f_rej = Family(
+        "ptt_admission_rejected_total", "counter",
+        "Submits rejected at the door, by tenant and reason",
+    )
+    f_shed = Family(
+        "ptt_admission_shed_total", "counter",
+        "Submits shed by the global queue cap, by tenant",
+    )
+    for (tenant, reason), n in sorted(rejected.items()):
+        f_rej.add(n, {"tenant": tenant, "reason": reason})
+        if reason == "queue_full":
+            f_shed.add(n, {"tenant": tenant})
+    f_dedup = Family(
+        "ptt_admission_deduped_total", "counter",
+        "Retried submits answered by an existing job (submit_id)",
+    )
+    for tenant, n in sorted(deduped.items()):
+        f_dedup.add(n, {"tenant": tenant})
+    return [f_adm, f_rej, f_shed, f_dedup]
+
+
 # ------------------------------------------------------- daemon scrape
 
 
@@ -315,10 +351,29 @@ def scheduler_metrics(
                 continue
     if "states_per_sec" not in snap and last.get("states_per_sec"):
         snap["states_per_sec"] = last["states_per_sec"]
-    return [
+    fams = [
         f_up, f_uptime, f_jobs, f_queue, f_active, f_slices, f_susp,
         f_warm, f_cache, f_centries,
     ] + _engine_families(stats, snap)
+    adm = getattr(sched, "admission", None)
+    if adm is not None:
+        snap_adm = adm.snapshot()
+        rejected = {}
+        for key, n in snap_adm["rejected"].items():
+            # reasons never contain "/" (admission.REASON_*), tenant
+            # names might — split from the right
+            tenant, _sl, reason = key.rpartition("/")
+            rejected[(tenant, reason)] = n
+        fams += _admission_families(
+            snap_adm["admitted"], rejected, snap_adm["deduped"]
+        )
+    fams.append(
+        Family(
+            "ptt_persist_failures_total", "counter",
+            "queue.json snapshots that failed past the retry",
+        ).add(getattr(sched, "persist_failures", 0) or None)
+    )
+    return fams
 
 
 # -------------------------------------------------------- file scrape
@@ -338,8 +393,21 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     hbm = 0
     work: Dict[str, int] = {}
     spill_last: Dict[str, object] = {}
+    adm_admitted: Dict[str, float] = {}
+    adm_rejected: Dict[Tuple[str, str], float] = {}
+    adm_deduped: Dict[str, float] = {}
     for e in events:
         ev = e.get("event")
+        if ev == "admission":
+            tenant = str(e.get("tenant", "?"))
+            action = e.get("action")
+            if action == "admit":
+                adm_admitted[tenant] = adm_admitted.get(tenant, 0) + 1
+            elif action == "dedup":
+                adm_deduped[tenant] = adm_deduped.get(tenant, 0) + 1
+            elif action in ("reject", "shed"):
+                key = (tenant, str(e.get("reason", "?")))
+                adm_rejected[key] = adm_rejected.get(key, 0) + 1
         if ev == "spill":
             # cumulative v9 counters: the NEWEST record is the total —
             # the event fallback so a live/crashed tiered run's stream
@@ -404,6 +472,10 @@ def stream_metrics(events: List[dict]) -> List[Family]:
         stats.setdefault(k, v)
 
     fams = _engine_families(stats, snap)
+    if adm_admitted or adm_rejected or adm_deduped:
+        fams += _admission_families(
+            adm_admitted, adm_rejected, adm_deduped
+        )
 
     # daemon streams additionally carry the job lifecycle
     from pulsar_tlaplus_tpu.obs import report

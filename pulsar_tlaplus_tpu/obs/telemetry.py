@@ -94,10 +94,20 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # cross-check that per-level spill bytes are monotone-cumulative
 # (a spill event whose counters go backwards is a torn writer or a
 # re-based store; docs/memory.md).
+# v10 (round 17, the hardened open-network daemon): run headers carry
+# ``tenant`` — the bearer-token-derived tenant the run was executed
+# for (null on standalone runs; REQUIRED at v10 like profile_sig /
+# hbm_budget so per-tenant trajectories always split) — and the
+# service layer emits three new events: ``admission`` (one per submit
+# decision: admit / reject / shed / dedup, with tenant + reason),
+# ``auth`` (TCP handshake accept/reject), and ``deadline`` (a job
+# cancelled by the deadline sweep, ``stop_reason="deadline"``).  The
+# ``spill`` record may carry ``degraded: true`` when the spill tier
+# lost durability to ENOSPC (stop_reason="spill_enospc").
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -160,6 +170,15 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     # (null on untiered runs) and the cumulative ``spill`` record —
     # gated so every committed v8-and-older stream stays clean.
     ("run_header", "hbm_budget"): 9,
+    # v10 (round 17): tenant identity on every run header (null
+    # outside the daemon) and the open-network service events —
+    # admission decisions, TCP auth handshakes, deadline cancels —
+    # gated so every committed v9-and-older stream stays clean.
+    ("run_header", "tenant"): 10,
+    ("admission", "action"): 10,
+    ("admission", "tenant"): 10,
+    ("auth", "action"): 10,
+    ("deadline", "job_id"): 10,
     ("spill", "tier"): 9,
     ("spill", "keys_evicted"): 9,
     ("spill", "rows_evicted"): 9,
@@ -174,7 +193,7 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # hbm_budget — the tiered-store byte budget, null when untiered)
     "run_header": (
         "engine", "visited_impl", "config_sig", "profile_sig",
-        "hbm_budget",
+        "hbm_budget", "tenant",
     ),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress
@@ -247,6 +266,15 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "job_cancel": ("job_id",),
     # daemon lifecycle: start (socket, pid, warmed specs) / stop
     "serve": ("action",),
+    # open-network hardening (r17, service/): one admission record
+    # per submit decision — action in {admit, reject, shed, dedup},
+    # reason in {queue_full, tenant_queued, tenant_running,
+    # tenant_states} on rejections; auth records the TCP handshake
+    # (accept carries the derived tenant); deadline records the
+    # sweep cancelling an expired job (stop_reason="deadline")
+    "admission": ("action", "tenant"),
+    "auth": ("action",),
+    "deadline": ("job_id",),
 }
 
 

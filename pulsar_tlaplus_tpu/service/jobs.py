@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 import time
-import uuid
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
@@ -37,7 +36,13 @@ TERMINAL = frozenset((DONE, FAILED, CANCELLED))
 
 
 def new_job_id() -> str:
-    return uuid.uuid4().hex[:10]
+    # 80 CSPRNG bits: job ids double as capability-ish handles on the
+    # TCP transport (docs/service.md Security scope note), so they
+    # must be unguessable, not merely unique (uuid4().hex prefixes
+    # carry fixed version/variant nibbles; token_hex is all random)
+    import secrets
+
+    return secrets.token_hex(10)
 
 
 @dataclass
@@ -49,6 +54,19 @@ class Job:
     invariants: Optional[List[str]] = None  # None = the cfg INVARIANTS
     max_states: Optional[int] = None  # None = the service default
     time_budget_s: Optional[float] = None  # cumulative across slices
+    # open-network identity + scheduling class (r17): the tenant is
+    # DERIVED from the presented bearer token (never client-claimed
+    # over TCP; "local" on the trusted unix socket); priority orders
+    # the claim (higher first, FIFO within a class, and a waiting
+    # higher-priority job preempts a running lower one at its next
+    # level boundary); deadline_unix is the absolute wall instant
+    # past which the job is cancelled with stop_reason="deadline";
+    # submit_id is the client-supplied idempotency key — a retried
+    # submit with the same (tenant, submit_id) returns the SAME job
+    tenant: str = "local"
+    priority: int = 0
+    deadline_unix: Optional[float] = None
+    submit_id: Optional[str] = None
     state: str = QUEUED
     submitted_unix: float = field(default_factory=lambda: time.time())
     started_unix: Optional[float] = None
@@ -77,6 +95,14 @@ class Job:
     def result_path(self) -> str:
         return os.path.join(self.dir, "result.json")
 
+    @property
+    def record_path(self) -> str:
+        """The per-job submit record (``job.json``): the static
+        submit-time fields, written once at submit so a corrupt
+        ``queue.json`` can be REBUILT from the job dirs alone
+        (``serve --recover`` torn-queue recovery)."""
+        return os.path.join(self.dir, "job.json")
+
     # ------------------------------------------------ (de)serialize
 
     def to_dict(self) -> dict:
@@ -98,12 +124,16 @@ class Job:
             "spec": self.spec,
             "cfg_path": self.cfg_path,
             "state": self.state,
+            "tenant": self.tenant,
+            "priority": self.priority,
             "submitted_unix": round(self.submitted_unix, 3),
             "slices": self.slices,
             "suspends": self.suspends,
             "run_ids": list(self.run_ids),
             "wall_s": round(self.wall_s, 3),
         }
+        if self.deadline_unix is not None:
+            s["deadline_unix"] = round(self.deadline_unix, 3)
         if self.error:
             s["error"] = self.error
         if self.result:

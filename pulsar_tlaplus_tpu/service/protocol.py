@@ -1,20 +1,25 @@
-"""Wire protocol: JSONL request/response over a local unix socket.
+"""Wire protocol: JSONL request/response over unix or TCP sockets.
 
 One connection carries one request and its response(s).  Every message
 is a single JSON object on one ``\\n``-terminated line (the same
 crash-durable line discipline as the telemetry streams):
 
-- request: ``{"op": "submit", ...}``
-- response: ``{"ok": true, ...}`` or ``{"ok": false, "error": "..."}``
+- request: ``{"op": "submit", ...}`` — over TCP, additionally an
+  ``"auth": "<bearer token>"`` field (service/auth.py)
+- response: ``{"ok": true, ...}`` or ``{"ok": false, "error": "...",
+  "code": "..."}`` — ``code`` is the TYPED rejection class the client
+  maps to a distinct exit code: ``auth`` (bad/missing token),
+  ``quota`` (per-tenant quota), ``capacity`` (global load shed),
+  ``bad_request`` / ``protocol`` (everything else)
 - ``watch`` responses stream: one ``{"ok": true, "streaming": true}``
   acknowledgment, then ``{"event": {...}}`` lines relaying the job's
   telemetry records (level progress, heartbeat, per-slice run headers
   — each under the slice's run_id), terminated by ``{"done": {...}}``
   with the job summary + result.
 
-The daemon listens on a filesystem socket inside its state dir, so
-reachability is filesystem permissions — no auth layer, same trust
-model as the checkpoint frames themselves.
+Addresses: a filesystem path is a unix socket (reachability IS
+filesystem permissions — the no-auth localhost path); ``tcp://HOST:
+PORT`` is the authenticated open-network path (``serve --tcp``).
 """
 
 from __future__ import annotations
@@ -37,9 +42,43 @@ OPS = (
 # diameter, so this is generous
 MAX_LINE = 32 << 20
 
+# client-supplied scheduling priority is clamped into this range at
+# the daemon's door: (priority, FIFO) claim order + level-boundary
+# preemption mean an unbounded value would let one tenant starve
+# every other — quotas cap job counts, this caps the knob itself
+PRIORITY_MIN = -9
+PRIORITY_MAX = 9
+
 
 class ProtocolError(RuntimeError):
     """Malformed frame / oversized line / unexpected EOF."""
+
+
+TCP_PREFIX = "tcp://"
+
+
+def is_tcp(address: str) -> bool:
+    return address.startswith(TCP_PREFIX)
+
+
+def parse_tcp(address: str):
+    """``tcp://HOST:PORT`` -> (host, port); raises ValueError with a
+    usable message on malformed input."""
+    body = address[len(TCP_PREFIX):]
+    host, sep, port_s = body.rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"bad TCP address {address!r} (want tcp://HOST:PORT)"
+        )
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"bad TCP port in {address!r} (want tcp://HOST:PORT)"
+        ) from None
+    if not 0 <= port <= 65535:
+        raise ValueError(f"TCP port out of range in {address!r}")
+    return host, port
 
 
 def send_json(wfile, obj: dict) -> None:
@@ -68,18 +107,28 @@ def recv_json(rfile) -> Optional[dict]:
     return obj
 
 
-def connect(socket_path: str, timeout: Optional[float] = 10.0):
-    """Client-side connect; raises FileNotFoundError/ConnectionError
-    with the path in the message (the usual failure is a daemon that
-    is not running)."""
-    if not os.path.exists(socket_path):
+def connect(address: str, timeout: Optional[float] = 10.0):
+    """Client-side connect to a unix path or ``tcp://HOST:PORT``;
+    raises FileNotFoundError/ConnectionError with the address in the
+    message (the usual failure is a daemon that is not running)."""
+    if is_tcp(address):
+        host, port = parse_tcp(address)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        try:
+            s.connect((host, port))
+        except OSError:
+            s.close()
+            raise
+        return s
+    if not os.path.exists(address):
         raise FileNotFoundError(
-            f"no daemon socket at {socket_path!r} (is `serve` running?)"
+            f"no daemon socket at {address!r} (is `serve` running?)"
         )
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.settimeout(timeout)
     try:
-        s.connect(socket_path)
+        s.connect(address)
     except OSError:
         s.close()
         raise
@@ -128,5 +177,8 @@ def stream(
                 return
 
 
-def error_response(msg: str) -> dict:
-    return {"ok": False, "error": msg}
+def error_response(msg: str, code: str = "bad_request") -> dict:
+    """Typed refusal: ``code`` is the machine-readable rejection
+    class (``auth`` / ``quota`` / ``capacity`` / ``bad_request`` /
+    ``protocol``) the client maps to its distinct exit code."""
+    return {"ok": False, "error": msg, "code": code}

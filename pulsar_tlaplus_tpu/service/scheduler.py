@@ -40,9 +40,33 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.service import admission as admmod
 from pulsar_tlaplus_tpu.service import jobs as jobmod
 from pulsar_tlaplus_tpu.service.jobs import Job
 from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
+from pulsar_tlaplus_tpu.utils import faults
+
+
+def _write_json_atomic(path: str, obj, _inject=None):
+    """Write ``obj`` as JSON to ``path`` through a per-process tmp +
+    ``os.replace``, removing the half-written tmp on failure.  Returns
+    None on success, the ``OSError`` on failure — the caller decides
+    whether to retry or log-and-continue (``_inject`` is the
+    PTT_FAULT hook: an exception raised before any byte is written)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            if _inject is not None:
+                raise _inject
+            json.dump(obj, f)
+        os.replace(tmp, path)
+        return None
+    except OSError as e:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return e
 
 
 @dataclass
@@ -68,6 +92,19 @@ class ServiceConfig:
     # disables lookups (serve --no-profiles).  The config knobs above
     # are the fallback for knobs the profile does not pin.
     profiles: str = "auto"
+    # open-network hardening (r17, docs/service.md "Security" /
+    # "Admission"): `tcp` = "HOST:PORT" adds an authenticated TCP
+    # listener beside the unix socket (port 0 = ephemeral, the bound
+    # port lands in daemon.tcp_port); it REQUIRES `tokens_path` (a
+    # tokens.json mapping bearer tokens to tenants — service/auth.py).
+    # Quotas: 0 = unlimited; rejections are typed wire errors + the
+    # ptt_admission_* counters, never silent queueing.
+    tcp: str = ""
+    tokens_path: str = ""
+    queue_cap: int = 64  # global alive-job cap (load shedding)
+    tenant_max_queued: int = 16
+    tenant_max_running: int = 0
+    tenant_max_states: int = 0
     specs: Tuple[str, ...] = ()  # modules to prewarm at startup
     spec_dir: str = ""  # where default <spec>.cfg files live
     prewarm_tiers: bool = True
@@ -278,6 +315,20 @@ class Scheduler:
         self.fifo: deque = deque()
         self.cv = threading.Condition()
         self._persist_lock = threading.Lock()
+        # admission control (r17): quota checks + the counters the
+        # `metrics` verb exports as ptt_admission_*
+        self.admission = admmod.AdmissionControl(
+            queue_cap=config.queue_cap,
+            tenant_max_queued=config.tenant_max_queued,
+            tenant_max_running=config.tenant_max_running,
+            tenant_max_states=config.tenant_max_states,
+            default_max_states=config.max_states,
+        )
+        # idempotent resubmit: (tenant, submit_id) -> job_id, rebuilt
+        # on recover, pruned with the retention cap
+        self._submit_index: Dict[Tuple[str, str], str] = {}
+        self._persist_n = 0  # queue.json snapshot sequence (fault site)
+        self.persist_failures = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._running_id: Optional[str] = None
@@ -309,10 +360,33 @@ class Scheduler:
                     "fifo": list(self.fifo),
                     "running": self._running_id,
                 }
-            tmp = f"{self.config.queue_path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(snap, f)
-            os.replace(tmp, self.config.queue_path)
+            self._persist_n += 1
+            inject = "enospc" in faults.poll(
+                "persist", self._persist_n
+            )
+            # a full/flaky disk must not take the daemon down: one
+            # retry after removing the half-written tmp (freeing it
+            # is what lets an ENOSPC retry succeed), then log and
+            # carry on — the very next transition persists again, and
+            # the torn-queue recovery path (`serve --recover`)
+            # rebuilds from the per-job dirs if the worst happens
+            for attempt in (0, 1):
+                err = _write_json_atomic(
+                    self.config.queue_path, snap,
+                    _inject=(
+                        faults.enospc_error("persist", self._persist_n)
+                        if inject and attempt == 0
+                        else None
+                    ),
+                )
+                if err is None:
+                    break
+                if attempt == 1:
+                    self.persist_failures += 1
+                    self._log(
+                        f"queue.json persist FAILED ({err!r:.120}); "
+                        "continuing — next transition retries"
+                    )
 
     def _prune_terminal(self) -> None:
         """Retention cap: the oldest terminal jobs beyond
@@ -329,6 +403,10 @@ class Scheduler:
             drop = term[: max(0, len(term) - cap)]
             for j in drop:
                 del self.jobs[j.job_id]
+                if j.submit_id:
+                    self._submit_index.pop(
+                        (j.tenant, j.submit_id), None
+                    )
         for j in drop:
             shutil.rmtree(j.dir, ignore_errors=True)
 
@@ -337,17 +415,29 @@ class Scheduler:
         status/result queries; interrupted jobs re-enter the queue —
         at the FRONT when they were running (their work is the
         oldest), as suspended runs when their frame survived, as fresh
-        queued runs otherwise.  Returns the number of runnable jobs."""
+        queued runs otherwise.  A CORRUPT/TRUNCATED ``queue.json``
+        (torn by a crash mid-write on a broken disk) is quarantined to
+        ``queue.json.corrupt.<ts>`` and the queue is REBUILT from the
+        per-job ``jobs/<id>/`` dirs — never a crash (r17 torn-queue
+        recovery).  Returns the number of runnable jobs."""
         try:
             with open(self.config.queue_path) as f:
                 snap = json.load(f)
         except FileNotFoundError:
             return 0
         except (OSError, json.JSONDecodeError, ValueError) as e:
-            raise ValueError(
-                f"unreadable queue state at {self.config.queue_path!r}:"
-                f" {e}"
-            ) from e
+            quarantine = (
+                f"{self.config.queue_path}.corrupt.{int(time.time())}"
+            )
+            try:
+                os.replace(self.config.queue_path, quarantine)
+            except OSError:
+                quarantine = "<unmovable>"
+            self._log(
+                f"queue.json is corrupt ({e!r:.120}); quarantined to "
+                f"{quarantine} and rebuilding from the job dirs"
+            )
+            return self._rebuild_from_dirs()
         with self.cv:
             for d in snap.get("jobs", []):
                 job = Job.from_dict(d)
@@ -374,8 +464,79 @@ class Scheduler:
                 self.fifo.append(jid)
                 n += 1
             self._running_id = None
+            self._reindex_submit_ids()
         self.persist()
         self._log(f"recovered {n} runnable job(s) from queue.json")
+        return n
+
+    def _reindex_submit_ids(self) -> None:
+        """Rebuild the idempotency index from the job table (caller
+        holds the cv) — a retried submit keeps deduplicating across a
+        daemon restart."""
+        self._submit_index = {
+            (j.tenant, j.submit_id): j.job_id
+            for j in self.jobs.values()
+            if j.submit_id
+        }
+
+    def _rebuild_from_dirs(self) -> int:
+        """Torn-queue recovery: reconstruct the job table from the
+        per-job ``jobs/<id>/job.json`` submit records, inferring each
+        job's state from its durable artifacts — ``result.json``
+        present = done, ``frame.npz`` present = suspended (resumable),
+        otherwise queued (conservative: a cancel that only ever lived
+        in the torn queue.json re-runs, which is safe).  Runnable jobs
+        re-enter the FIFO in submit order."""
+        try:
+            jids = sorted(os.listdir(self.config.jobs_dir))
+        except OSError:
+            jids = []
+        rebuilt: List[Job] = []
+        for jid in jids:
+            jdir = os.path.join(self.config.jobs_dir, jid)
+            rec_path = os.path.join(jdir, "job.json")
+            try:
+                with open(rec_path) as f:
+                    job = Job.from_dict(json.load(f))
+            except (OSError, json.JSONDecodeError, ValueError) as e:
+                self._log(
+                    f"rebuild: skipping job dir {jid!r} "
+                    f"(unreadable job.json: {e!r:.80})"
+                )
+                continue
+            job.dir = jdir  # the state dir may have moved
+            if os.path.exists(job.result_path):
+                try:
+                    with open(job.result_path) as f:
+                        job.result = json.load(f)
+                    job.state = jobmod.DONE
+                    if job.finished_unix is None:
+                        job.finished_unix = os.path.getmtime(
+                            job.result_path
+                        )
+                except (OSError, json.JSONDecodeError):
+                    job.state = jobmod.QUEUED
+                    job.result = None
+            elif os.path.exists(job.frame_path):
+                job.state = jobmod.SUSPENDED
+            else:
+                job.state = jobmod.QUEUED
+            rebuilt.append(job)
+        rebuilt.sort(key=lambda j: j.submitted_unix)
+        n = 0
+        with self.cv:
+            for job in rebuilt:
+                self.jobs[job.job_id] = job
+                if not job.terminal:
+                    self.fifo.append(job.job_id)
+                    n += 1
+            self._running_id = None
+            self._reindex_submit_ids()
+        self.persist()
+        self._log(
+            f"rebuilt {len(rebuilt)} job(s) ({n} runnable) from the "
+            "job dirs"
+        )
         return n
 
     # -------------------------------------------------------- control
@@ -403,6 +564,7 @@ class Scheduler:
         """Synchronous drain (in-process harnesses/tests): run slices
         until no runnable job remains."""
         while not self._stop.is_set():
+            self._sweep_deadlines()
             job = self._claim()
             if job is None:
                 return
@@ -417,9 +579,16 @@ class Scheduler:
         invariants: Optional[List[str]] = None,
         max_states: Optional[int] = None,
         time_budget_s: Optional[float] = None,
+        tenant: str = "local",
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        submit_id: Optional[str] = None,
     ) -> Job:
         """Validate eagerly (bad specs/cfgs/invariants fail the submit,
-        not the queue) and enqueue."""
+        not the queue), deduplicate on the client's ``submit_id``
+        (a retried submit never enqueues twice), run admission control
+        (over-quota/over-capacity submits are REJECTED at the door —
+        :class:`admission.AdmissionError`), and enqueue."""
         from pulsar_tlaplus_tpu.utils import cfg as cfgmod
 
         cfg_path = os.path.abspath(cfg_path)
@@ -430,33 +599,91 @@ class Scheduler:
                 f"max_states {max_states} exceeds the service ceiling "
                 f"{self.config.max_states} (serve --maxstates)"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0: {deadline_s}"
+            )
         jid = jobmod.new_job_id()
-        jdir = os.path.join(self.config.jobs_dir, jid)
-        os.makedirs(jdir, exist_ok=True)
-        job = Job(
-            job_id=jid,
-            spec=spec,
-            cfg_path=cfg_path,
-            dir=jdir,
-            # the RESOLVED set (submitted list or cfg INVARIANTS) so
-            # scheduling slices never rebuild the model to re-validate
-            invariants=list(invs),
-            max_states=max_states,
-            time_budget_s=time_budget_s,
-        )
+        now = time.time()
         with self.cv:
+            if submit_id:
+                prev = self._submit_index.get((tenant, str(submit_id)))
+                if prev is not None and prev in self.jobs:
+                    # idempotent resubmit: the SAME job, no new enqueue
+                    # (the reply a dropped connection lost is re-earned
+                    # by the retry)
+                    self.admission.count_dedup(tenant)
+                    self.tel.emit(
+                        "admission", action="dedup", tenant=tenant,
+                        job_id=prev, submit_id=str(submit_id),
+                    )
+                    return self.jobs[prev]
+            try:
+                self.admission.check(
+                    tenant, max_states, list(self.jobs.values())
+                )
+            except admmod.AdmissionError as e:
+                self.tel.emit(
+                    "admission",
+                    action="shed" if e.code == "capacity" else "reject",
+                    tenant=tenant, reason=e.reason, spec=spec,
+                )
+                raise
+            jdir = os.path.join(self.config.jobs_dir, jid)
+            os.makedirs(jdir, exist_ok=True)
+            job = Job(
+                job_id=jid,
+                spec=spec,
+                cfg_path=cfg_path,
+                dir=jdir,
+                # the RESOLVED set (submitted list or cfg INVARIANTS) so
+                # scheduling slices never rebuild the model to re-validate
+                invariants=list(invs),
+                max_states=max_states,
+                time_budget_s=time_budget_s,
+                tenant=tenant,
+                priority=int(priority),
+                deadline_unix=(
+                    now + float(deadline_s)
+                    if deadline_s is not None
+                    else None
+                ),
+                submit_id=str(submit_id) if submit_id else None,
+            )
+            self.admission.count_admit(tenant)
             self.jobs[jid] = job
             self.fifo.append(jid)
+            if job.submit_id:
+                self._submit_index[(tenant, job.submit_id)] = jid
             self.cv.notify_all()
+        # the per-job submit record: the static fields a torn-queue
+        # rebuild needs (written before the queue snapshot so the dir
+        # is never behind the snapshot describing it).  Best-effort:
+        # the job is already ADMITTED — a record-write failure must
+        # degrade the torn-queue rebuild for this one job, not fail a
+        # submit the client would then retry into a ghost duplicate
+        err = _write_json_atomic(job.record_path, job.to_dict())
+        if err is not None:
+            self._log(
+                f"job {jid}: job.json write FAILED ({err!r:.120}); "
+                "torn-queue rebuild would skip this job"
+            )
         self.persist()
         # wall_unix anchors this stream's clock for obs/trace.py (the
         # daemon stream has no run_header; the first anchored record
         # fixes the run_id's offset on the shared wall timeline)
         self.tel.emit(
-            "job_submit", job_id=jid, spec=spec,
-            wall_unix=round(time.time(), 3),
+            "job_submit", job_id=jid, spec=spec, tenant=tenant,
+            priority=int(priority),
+            wall_unix=round(now, 3),
         )
-        self._log(f"job {jid}: submitted ({spec} @ {cfg_path})")
+        self.tel.emit(
+            "admission", action="admit", tenant=tenant, job_id=jid,
+        )
+        self._log(
+            f"job {jid}: submitted ({spec} @ {cfg_path}, "
+            f"tenant={tenant}, prio={priority})"
+        )
         return job
 
     def cancel(self, job_id: str) -> Job:
@@ -490,13 +717,18 @@ class Scheduler:
         with self.cv:
             return self._get(job_id)
 
-    def snapshot(self) -> List[dict]:
+    def snapshot(self, tenant: Optional[str] = None) -> List[dict]:
+        """Job-table summaries, oldest first.  ``tenant`` scopes the
+        listing to that tenant's own jobs — the TCP path passes the
+        authenticated tenant so a listing never hands one tenant the
+        (unguessable-by-design) job ids of another."""
         with self.cv:
             return [
                 j.summary()
                 for j in sorted(
                     self.jobs.values(), key=lambda j: j.submitted_unix
                 )
+                if tenant is None or j.tenant == tenant
             ]
 
     def wait(
@@ -529,10 +761,19 @@ class Scheduler:
         return bool(self.fifo)
 
     def _claim(self) -> Optional[Job]:
+        """Claim order (r17): highest priority first, FIFO within a
+        priority class (the scan is stable — the leftmost of the max
+        class wins, and a suspended job re-queued at the tail keeps
+        round-robin fairness within its class)."""
         with self.cv:
             if self._stop.is_set() or not self.fifo:
                 return None
-            jid = self.fifo.popleft()
+            best = max(self.jobs[j].priority for j in self.fifo)
+            jid = next(
+                j for j in self.fifo
+                if self.jobs[j].priority == best
+            )
+            self.fifo.remove(jid)
             job = self.jobs[jid]
             self._running_id = jid
             job.state = jobmod.RUNNING
@@ -543,6 +784,7 @@ class Scheduler:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self._sweep_deadlines()
             job = self._claim()
             if job is None:
                 with self.cv:
@@ -554,6 +796,93 @@ class Scheduler:
     def _other_waiting(self) -> bool:
         with self.cv:
             return bool(self.fifo)
+
+    def _higher_waiting(self, priority: int) -> bool:
+        """A queued job outranking ``priority`` — the preemption
+        signal the suspend hook polls at level boundaries."""
+        with self.cv:
+            return any(
+                self.jobs[jid].priority > priority
+                for jid in self.fifo
+            )
+
+    # ------------------------------------------------------ deadlines
+
+    def _sweep_deadlines(self) -> int:
+        """Cancel queued/suspended jobs whose deadline passed (the
+        running job cancels itself through the hook's deadline check).
+        Returns the number of jobs expired this sweep."""
+        now = time.time()
+        expired: List[Job] = []
+        with self.cv:
+            for job in self.jobs.values():
+                if (
+                    job.terminal
+                    or job.deadline_unix is None
+                    or now < job.deadline_unix
+                    or job.job_id == self._running_id
+                ):
+                    continue
+                try:
+                    self.fifo.remove(job.job_id)
+                except ValueError:
+                    pass
+                expired.append(job)
+        for job in expired:
+            self._expire(job)
+        return len(expired)
+
+    def _expire(self, job: Job, r=None) -> None:
+        """Deadline-exceeded completion: an honest truncation record
+        (``stop_reason="deadline"``, never a verification verdict)
+        carrying whatever progress the job banked, plus the v10
+        ``deadline`` telemetry event."""
+        progress = dict(job.progress or {})
+        if r is not None:
+            progress = {
+                "distinct_states": int(r.distinct_states),
+                "diameter": int(r.diameter),
+                "level_sizes": [int(x) for x in r.level_sizes],
+            }
+            job.wall_s = float(r.wall_s)
+        result = {
+            "status": "deadline",
+            "truncated": True,
+            "stop_reason": "deadline",
+            **progress,
+            "wall_s": round(float(job.wall_s), 3),
+            "slices": job.slices,
+            "suspends": job.suspends,
+            "run_ids": list(job.run_ids),
+        }
+        with self.cv:
+            # a concurrent cancel() may have won since the sweep
+            # released the cv — the FIRST terminal transition stands
+            # (re-finishing would flip a state the cancelling client
+            # was already told and double the job_result event)
+            if job.terminal:
+                return
+            job.result = result
+            self._finish(job, jobmod.DONE)
+        self.tel.emit(
+            "deadline", job_id=job.job_id, tenant=job.tenant,
+            deadline_unix=round(job.deadline_unix or 0.0, 3),
+        )
+        err = _write_json_atomic(job.result_path, job.result)
+        if err is not None:
+            # a full disk must not kill the sweep (and with it the
+            # scheduler thread): the result stays queryable in the
+            # table and in the job_result event
+            self._log(
+                f"job {job.job_id}: deadline result.json write "
+                f"FAILED ({err!r:.120}); table record stands"
+            )
+        self.persist()
+        self._log(
+            f"job {job.job_id}: deadline exceeded — cancelled "
+            f"(stop_reason=deadline, {progress.get('distinct_states', 0)}"
+            " states banked)"
+        )
 
     def _mk_hook(
         self, job: Job, deadline: Optional[float],
@@ -590,6 +919,14 @@ class Scheduler:
                 )
             if job.cancel_requested:
                 return "cancelled"
+            if (
+                job.deadline_unix is not None
+                and time.time() >= job.deadline_unix
+            ):
+                # deadline exceeded mid-run: discard the run (the
+                # scheduler converts the "cancelled" stop into the
+                # deadline completion record)
+                return "cancelled"
             if self._stop.is_set():
                 return "suspended"
             # the engine polls BEFORE expanding each level, so the
@@ -599,6 +936,11 @@ class Scheduler:
             # slice therefore advances >= one level before yielding.
             if polls[0] == 1:
                 return None
+            if self._higher_waiting(job.priority):
+                # priority preemption: a waiting higher-priority job
+                # takes the device at this level boundary — no need to
+                # wait out the slice quantum
+                return "suspended"
             if (
                 deadline is not None
                 and time.monotonic() >= deadline
@@ -661,6 +1003,9 @@ class Scheduler:
         ck.checkpoint_every = self.config.checkpoint_every
         ck._telemetry_arg = job.events_path
         ck.time_budget_s = remaining
+        # tenant identity on every slice's engine run header (schema
+        # v10 run_header.tenant — per-tenant attribution end to end)
+        ck.tenant = job.tenant
         prev_wall = float(job.wall_s)
         hook = self._mk_hook(
             job, time.monotonic() + self.config.slice_s,
@@ -746,6 +1091,15 @@ class Scheduler:
             )
             return
         if r.stop_reason == "cancelled":
+            if not job.cancel_requested and (
+                job.deadline_unix is not None
+                and time.time() >= job.deadline_unix
+            ):
+                # the hook discarded the run because the DEADLINE
+                # passed, not because a client asked: complete with
+                # the honest deadline record instead of "cancelled"
+                self._expire(job, r)
+                return
             with self.cv:
                 self._finish(job, jobmod.CANCELLED)
             self.persist()
@@ -809,10 +1163,14 @@ class Scheduler:
             }
         else:
             job.result = self.result_record(job, r)
-        tmp = f"{job.result_path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(job.result, f)
-        os.replace(tmp, job.result_path)
+        err = _write_json_atomic(job.result_path, job.result)
+        if err is not None:
+            # disk-full on the result artifact: the completion stands
+            # (table + job_result event); only the durable copy is lost
+            self._log(
+                f"job {job.job_id}: result.json write FAILED "
+                f"({err!r:.120}); table record stands"
+            )
         with self.cv:
             self._finish(job, jobmod.DONE)
         self.persist()
@@ -829,7 +1187,12 @@ class Scheduler:
         self._log(f"job {job.job_id}: FAILED ({job.error[:120]})")
 
     def _finish(self, job: Job, state: str) -> None:
-        """Terminal transition; caller holds the cv."""
+        """Terminal transition; caller holds the cv.  Idempotence
+        guard: the first terminal transition wins — a deadline sweep
+        and a client cancel racing to finish the same job must not
+        emit two job_result events or flip the state twice."""
+        if job.terminal:
+            return
         job.state = state
         job.finished_unix = time.time()
         if self._running_id == job.job_id:
@@ -844,6 +1207,7 @@ class Scheduler:
         self.tel.emit(
             "job_result",
             job_id=job.job_id,
+            tenant=job.tenant,
             status=(
                 job.result.get("status", state)
                 if job.result

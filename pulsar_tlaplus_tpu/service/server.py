@@ -23,6 +23,8 @@ import time
 from typing import Optional
 
 from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.service import admission as admmod
+from pulsar_tlaplus_tpu.service import auth as authmod
 from pulsar_tlaplus_tpu.service import jobs as jobmod
 from pulsar_tlaplus_tpu.service import protocol
 from pulsar_tlaplus_tpu.service.scheduler import (
@@ -30,11 +32,47 @@ from pulsar_tlaplus_tpu.service.scheduler import (
     Scheduler,
     ServiceConfig,
 )
+from pulsar_tlaplus_tpu.utils import faults
 
 # how long a watch stream may idle-poll a job's event file between
 # records before giving up (the job may be waiting behind a long slice
 # of another job — that is normal, so this is generous)
 WATCH_POLL_S = 0.05
+
+
+class _FaultyWriter:
+    """The reply-side PTT_FAULT shim: realizes ``drop@conn:N`` (close
+    before any byte of the reply) and ``torn@line:N`` (write half of
+    the N-th protocol line the daemon ever sends, then close) by
+    raising ``ConnectionResetError`` — exactly what a flaky network
+    looks like to the handler, so the SAME cleanup path runs.  Inert
+    (two attribute reads) when ``PTT_FAULT`` is unset."""
+
+    def __init__(self, wfile, server, drop: bool = False):
+        self._w = wfile
+        self._server = server
+        self._drop = drop
+
+    def write(self, data):
+        if self._drop:
+            raise ConnectionResetError(
+                "PTT_FAULT drop@conn: reply withheld"
+            )
+        if faults.active():
+            n = self._server._next_line()
+            if "torn" in faults.poll("line", n):
+                self._w.write(data[: max(1, len(data) // 2)])
+                self._w.flush()
+                raise ConnectionResetError(
+                    f"PTT_FAULT torn@line:{n}"
+                )
+        return self._w.write(data)
+
+    def flush(self):
+        self._w.flush()
+
+    def close(self):
+        self._w.close()
 
 
 class ServiceDaemon:
@@ -60,13 +98,51 @@ class ServiceDaemon:
             config, pool=self.pool, telemetry=self.tel, log=self._log
         )
         self._sock: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
+        self._tcp_sock: Optional[socket.socket] = None
+        self.tcp_port: Optional[int] = None
+        self._accept_threads: list = []
         self._shutdown_evt = threading.Event()
         self._shutdown_done = threading.Event()
         self._t0 = time.time()
         self.warmed: list = []
+        # bearer tokens for the TCP transport (service/auth.py): the
+        # unix socket stays the no-auth localhost path
+        self.tokens: dict = {}
+        if config.tokens_path:
+            self.tokens = authmod.load_tokens(config.tokens_path)
+        if config.tcp and not self.tokens:
+            raise ValueError(
+                "serve --tcp requires --tokens TOKENS.json: the TCP "
+                "transport is authenticated (docs/service.md Security)"
+            )
+        # validate HOST:PORT at construction (the CLI wraps ctor
+        # ValueErrors into a clean message; start() must not raise)
+        self._tcp_addr = None
+        if config.tcp:
+            self._tcp_addr = protocol.parse_tcp(
+                protocol.TCP_PREFIX + config.tcp
+            )
+        # service-layer fault-site counters (drop@conn / torn@line)
+        self._conn_n = 0
+        self._line_n = 0
+        self._fault_lock = threading.Lock()
+        # tenants whose first successful handshake was already logged
+        # (the accept audit record is once-per-tenant: routine polling
+        # opens a connection per request, and one record per poll
+        # would grow the daemon stream without bound)
+        self._auth_seen: set = set()
         if recover:
             self.sched.recover()
+
+    def _next_conn(self) -> int:
+        with self._fault_lock:
+            self._conn_n += 1
+            return self._conn_n
+
+    def _next_line(self) -> int:
+        with self._fault_lock:
+            self._line_n += 1
+            return self._line_n
 
     def _acquire_state_lock(self) -> None:
         """One daemon per state dir: a second `serve` would unlink the
@@ -130,17 +206,6 @@ class ServiceDaemon:
         return total
 
     def start(self) -> None:
-        self.tel.emit(
-            "serve",
-            action="start",
-            socket=self.config.socket_path,
-            pid=os.getpid(),
-            warmed=list(self.warmed),
-            # wall-clock anchor for this stream's run_id: obs/trace.py
-            # aligns the daemon's monotonic t axis against per-job
-            # engine streams through it
-            wall_unix=round(time.time(), 3),
-        )
         try:
             os.remove(self.config.socket_path)
         except OSError:
@@ -150,12 +215,42 @@ class ServiceDaemon:
         s.listen(16)
         s.settimeout(0.5)
         self._sock = s
-        self.sched.start()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="ptt-serve-accept",
-            daemon=True,
+        if self._tcp_addr is not None:
+            host, port = self._tcp_addr
+            ts = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ts.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ts.bind((host, port))
+            ts.listen(16)
+            ts.settimeout(0.5)
+            self._tcp_sock = ts
+            self.tcp_port = ts.getsockname()[1]
+            self._log(
+                f"TCP listener on {host}:{self.tcp_port} "
+                f"({len(self.tokens)} tenant token(s) loaded)"
+            )
+        self.tel.emit(
+            "serve",
+            action="start",
+            socket=self.config.socket_path,
+            tcp_port=self.tcp_port,
+            pid=os.getpid(),
+            warmed=list(self.warmed),
+            # wall-clock anchor for this stream's run_id: obs/trace.py
+            # aligns the daemon's monotonic t axis against per-job
+            # engine streams through it
+            wall_unix=round(time.time(), 3),
         )
-        self._accept_thread.start()
+        self.sched.start()
+        listeners = [(s, True)]
+        if self._tcp_sock is not None:
+            listeners.append((self._tcp_sock, False))
+        for sock, trusted in listeners:
+            t = threading.Thread(
+                target=self._accept_loop, args=(sock, trusted),
+                name="ptt-serve-accept", daemon=True,
+            )
+            t.start()
+            self._accept_threads.append(t)
         self._log(f"serving on {self.config.socket_path}")
 
     def install_signal_handlers(self) -> None:
@@ -206,12 +301,14 @@ class ServiceDaemon:
         # scheduler first: the running job suspends (frame + requeue)
         # before the queue snapshot persists
         self.sched.stop(timeout=600.0)
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        for attr in ("_sock", "_tcp_sock"):
+            sock = getattr(self, attr)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
         try:
             os.remove(self.config.socket_path)
         except OSError:
@@ -228,11 +325,8 @@ class ServiceDaemon:
 
     # ----------------------------------------------------- connection
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, sock: socket.socket, trusted: bool) -> None:
         while not self._shutdown_evt.is_set():
-            sock = self._sock
-            if sock is None:
-                return
             try:
                 conn, _addr = sock.accept()
             except socket.timeout:
@@ -240,22 +334,65 @@ class ServiceDaemon:
             except OSError:
                 return  # socket closed under us: shutting down
             t = threading.Thread(
-                target=self._handle_conn, args=(conn,), daemon=True
+                target=self._handle_conn, args=(conn, trusted),
+                daemon=True,
             )
             t.start()
 
-    def _handle_conn(self, conn: socket.socket) -> None:
+    def _handle_conn(
+        self, conn: socket.socket, trusted: bool = True
+    ) -> None:
         conn.settimeout(600.0)
+        r = w = None
         try:
             r = conn.makefile("r", encoding="utf-8")
-            w = conn.makefile("w", encoding="utf-8")
+            # the PTT_FAULT reply shim: drop@conn withholds this
+            # connection's whole reply (the request still PROCESSES —
+            # exactly the ack-lost shape idempotent resubmit exists
+            # for), torn@line tears the daemon's N-th sent line
+            drop = "drop" in faults.poll("conn", self._next_conn())
+            w = _FaultyWriter(
+                conn.makefile("w", encoding="utf-8"), self, drop=drop
+            )
             try:
                 req = protocol.recv_json(r)
             except protocol.ProtocolError as e:
-                protocol.send_json(w, protocol.error_response(str(e)))
+                protocol.send_json(
+                    w, protocol.error_response(str(e), code="protocol")
+                )
                 return
             if req is None:
                 return
+            if not trusted:
+                # TCP: the bearer-token handshake.  The tenant is
+                # DERIVED from the token — a TCP client can never
+                # name its own tenant
+                tenant = authmod.authenticate(
+                    self.tokens, req.get("auth")
+                )
+                if tenant is None:
+                    self.tel.emit(
+                        "auth", action="reject", op=req.get("op"),
+                    )
+                    protocol.send_json(
+                        w,
+                        protocol.error_response(
+                            "bad or missing bearer token "
+                            "(submit with --token; docs/service.md)",
+                            code="auth",
+                        ),
+                    )
+                    return
+                with self._fault_lock:
+                    first = tenant not in self._auth_seen
+                    self._auth_seen.add(tenant)
+                if first:
+                    self.tel.emit(
+                        "auth", action="accept", tenant=tenant
+                    )
+                req["_tenant"] = tenant
+            else:
+                req["_tenant"] = authmod.LOCAL_TENANT
             op = req.get("op")
             handler = getattr(self, f"_op_{op}", None)
             if op not in protocol.OPS or handler is None:
@@ -268,11 +405,36 @@ class ServiceDaemon:
                 return
             try:
                 handler(req, w)
+            except (BrokenPipeError, ConnectionResetError):
+                raise  # dead peer / injected fault: no error reply
+            except admmod.AdmissionError as e:
+                # typed rejection: the client maps `code` to its
+                # distinct exit code (quota=5, capacity=5, auth=4)
+                protocol.send_json(
+                    w, protocol.error_response(str(e), code=e.code)
+                )
             except (KeyError, ValueError, TypeError, OSError) as e:
                 protocol.send_json(w, protocol.error_response(str(e)))
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-reply: its problem, not ours
         finally:
+            # close the makefile wrappers EXPLICITLY before the
+            # socket: conn.close() only closes the fd once every
+            # makefile's _io_refs is gone, and an injected-fault
+            # traceback can keep r/w alive in a reference cycle until
+            # a gc that a quiet process may not run for minutes — the
+            # peer would block on a reply fd that is "closed" but
+            # never FINs.  shutdown() forces the FIN either way.
+            for obj in (w, r):
+                try:
+                    if obj is not None:
+                        obj.close()
+                except OSError:
+                    pass
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:
@@ -303,9 +465,23 @@ class ServiceDaemon:
             invariants=req.get("invariants"),
             max_states=req.get("max_states"),
             time_budget_s=req.get("time_budget_s"),
+            tenant=req["_tenant"],
+            priority=max(
+                protocol.PRIORITY_MIN,
+                min(
+                    protocol.PRIORITY_MAX,
+                    int(req.get("priority") or 0),
+                ),
+            ),
+            deadline_s=req.get("deadline_s"),
+            submit_id=req.get("submit_id"),
         )
         protocol.send_json(
-            w, {"ok": True, "job_id": job.job_id, "state": job.state}
+            w,
+            {
+                "ok": True, "job_id": job.job_id, "state": job.state,
+                "tenant": job.tenant,
+            },
         )
 
     def _op_status(self, req, w) -> None:
@@ -314,8 +490,20 @@ class ServiceDaemon:
             job = self.sched.get(jid)
             protocol.send_json(w, {"ok": True, "job": job.summary()})
         else:
+            # the listing is tenant-scoped over TCP: job ids are the
+            # capability handles guarding result/cancel/watch, and a
+            # global listing would hand every tenant everyone else's
+            tenant = req.get("_tenant")
             protocol.send_json(
-                w, {"ok": True, "jobs": self.sched.snapshot()}
+                w,
+                {
+                    "ok": True,
+                    "jobs": self.sched.snapshot(
+                        None
+                        if tenant == authmod.LOCAL_TENANT
+                        else tenant
+                    ),
+                },
             )
 
     def _op_result(self, req, w) -> None:
@@ -347,10 +535,15 @@ class ServiceDaemon:
         summary + result."""
         job = self.sched.get(req["job_id"])
         timeout_s = float(req.get("timeout_s", 3600.0))
+        # a reconnecting client passes back the last `pos` it saw so
+        # the relay RESUMES instead of replaying the whole stream
+        # (the client's (run_id, seq) dedup would discard the replay,
+        # but serializing a long run's entire events.jsonl per
+        # reconnect is O(file) waste on exactly the flaky links the
+        # reconnect logic exists for)
+        pos = max(0, int(req.get("offset") or 0))
         protocol.send_json(w, {"ok": True, "streaming": True})
         deadline = time.monotonic() + timeout_s
-        pos = 0
-        buf = ""
         while True:
             # observe terminal BEFORE draining: records written between
             # a drain and the terminal transition are caught by the
@@ -358,22 +551,31 @@ class ServiceDaemon:
             terminal = job.terminal
             emitted = False
             if os.path.exists(job.events_path):
-                with open(job.events_path) as f:
+                # binary mode: tell() is a plain byte offset, safe to
+                # hand to the client and seek() on reconnect
+                with open(job.events_path, "rb") as f:
                     f.seek(pos)
-                    chunk = f.read()
-                    pos = f.tell()
-                buf += chunk
-                while "\n" in buf:
-                    line, buf = buf.split("\n", 1)
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue  # torn tail line: next poll re-reads
-                    protocol.send_json(w, {"event": rec})
-                    emitted = True
+                    while True:
+                        line_start = f.tell()
+                        raw = f.readline()
+                        if not raw:
+                            break
+                        if not raw.endswith(b"\n"):
+                            # torn tail mid-write: re-read next poll
+                            f.seek(line_start)
+                            break
+                        pos = f.tell()
+                        line = raw.strip().decode("utf-8", "replace")
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        protocol.send_json(
+                            w, {"event": rec, "pos": pos}
+                        )
+                        emitted = True
             if terminal:
                 # one final drain already happened above; report
                 protocol.send_json(
@@ -417,6 +619,19 @@ class ServiceDaemon:
         protocol.send_json(w, {"ok": True, "metrics": text})
 
     def _op_shutdown(self, req, w) -> None:
+        if req.get("_tenant") != authmod.LOCAL_TENANT:
+            # daemon termination is an OPERATOR action: localhost
+            # (unix socket) only — a tenant token must not be able to
+            # stop every other tenant's jobs
+            protocol.send_json(
+                w,
+                protocol.error_response(
+                    "shutdown is localhost-only (connect via the "
+                    "unix socket)",
+                    code="auth",
+                ),
+            )
+            return
         protocol.send_json(w, {"ok": True, "stopping": True})
         # reply first, then arm: the main thread (wait_shutdown) or
         # the caller of shutdown() performs the actual stop

@@ -31,10 +31,19 @@ Design rules:
   checkpoint frames embed it, and :meth:`restore` refuses digest
   mismatches — a torn or swapped spill file can never feed a resumed
   run silently-wrong cold verdicts.
+- **ENOSPC degrades, never crashes** (r17).  A disk-full on the
+  background durable write — real, or the ``enospc@spill:N`` drill —
+  latches :attr:`degraded`: the in-RAM tiers stay fully queryable (so
+  everything already evicted keeps deduplicating exactly), further
+  durable writes stop, and the ENGINE finishes or truncates honestly
+  with ``stop_reason="spill_enospc"`` instead of surfacing a raw
+  worker crash.  A degraded store refuses :meth:`manifest` — a frame
+  must never anchor a resume on spill files that were not written.
 """
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import os
 import threading
@@ -45,6 +54,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from pulsar_tlaplus_tpu.store import compress as codec
+from pulsar_tlaplus_tpu.utils import faults
 
 _TMP_MARK = ".tmp."
 
@@ -149,6 +159,12 @@ class TieredStore:
         self._rows: List[Dict] = []
         self._logs: List[Dict] = []
         self._seq = 0
+        self._spill_write_n = 0  # enospc@spill fault-site counter
+        # ENOSPC degradation latch (r17): once set, durable writes
+        # stop (the in-RAM tiers stay queryable) and manifest() — the
+        # resume anchor — refuses to describe the incomplete dir
+        self.degraded = False
+        self.degraded_error: Optional[str] = None
         self._pending: List[Future] = []
         self._pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ptt-spill"
@@ -345,18 +361,45 @@ class TieredStore:
             self.stats.transfer_s += float(seconds)
 
     def _submit_encode(self, rec: Dict, encode, names) -> None:
+        # the enospc@spill:N drill arms on the SUBMITTING (engine)
+        # thread so the firing write is deterministic; the synthetic
+        # OSError is raised at the worker's write, where a real
+        # disk-full lands
+        self._spill_write_n += 1
+        inject = "enospc" in faults.poll("spill", self._spill_write_n)
+        inject_n = self._spill_write_n
+
         def job():
             t0 = time.perf_counter()
             blob, raw, comp = encode()
             files = digests = None
-            if self.durable:
-                blobs = blob if isinstance(blob, tuple) else (blob,)
-                fnames = names if isinstance(names, tuple) else (names,)
-                files, digests = [], []
-                for b, nm in zip(blobs, fnames):
-                    _atomic_write(os.path.join(self.spill_dir, nm), b)
-                    files.append(nm)
-                    digests.append(_digest(b))
+            try:
+                if self.durable and not self.degraded:
+                    if inject:
+                        raise faults.enospc_error("spill", inject_n)
+                    blobs = (
+                        blob if isinstance(blob, tuple) else (blob,)
+                    )
+                    fnames = (
+                        names if isinstance(names, tuple) else (names,)
+                    )
+                    files, digests = [], []
+                    for b, nm in zip(blobs, fnames):
+                        _atomic_write(
+                            os.path.join(self.spill_dir, nm), b
+                        )
+                        files.append(nm)
+                        digests.append(_digest(b))
+            except OSError as e:
+                if e.errno != errno.ENOSPC:
+                    raise  # only disk-full degrades; the rest is real
+                # ENOSPC: keep the run alive — the in-RAM copy stays
+                # queryable, durability is gone, the engine finishes
+                # honestly (stop_reason="spill_enospc")
+                files = digests = None
+                with self._lock:
+                    self.degraded = True
+                    self.degraded_error = f"{e}"
             with self._lock:
                 rec["comp"] = comp
                 if rec["kind"] == "logs":
@@ -406,6 +449,12 @@ class TieredStore:
         in checkpoint frames (requires :meth:`flush` first so every
         durable file + digest is final)."""
         self.flush()
+        if self.degraded:
+            raise ValueError(
+                "spill tier degraded (ENOSPC): the spill dir is "
+                "incomplete, so no frame may anchor a resume on it "
+                f"({self.degraded_error})"
+            )
         with self._lock:
             return {
                 "spill_v": 1,

@@ -14,13 +14,25 @@ variable into synthetic faults fired at named host-side sites:
     PTT_FAULT=sigterm@level:4          SIGTERM to self (preemption drill)
     PTT_FAULT=ckpt_fail@frame:1        transient OSError on checkpoint
                                        frame 1's write (retry drill)
+    PTT_FAULT=drop@conn:3              daemon closes connection 3
+                                       mid-reply (client-retry drill)
+    PTT_FAULT=torn@line:5              daemon writes half of protocol
+                                       line 5, then closes
+    PTT_FAULT=enospc@persist:2         queue.json persist 2 fails with
+                                       a synthetic ENOSPC
+    PTT_FAULT=enospc@spill:1           spill write 1 fails with ENOSPC
+                                       (tiered-store degradation drill)
     PTT_FAULT=oom@level:7,kill@level:9 comma-separated specs compose
 
 Syntax: ``kind@site:count`` — ``site`` is a counter the engines
 advance (``level`` = the BFS level about to be expanded, ``flush`` =
 the flush sequence number, ``frame`` = the checkpoint frame sequence
-number, ``sweep`` = the liveness engine's edge-sweep chunk), ``count``
-the value at which the spec fires.  Each spec fires AT MOST ONCE per process: a run that recovers
+number, ``sweep`` = the liveness engine's edge-sweep chunk; since
+round 17 the SERVICE layer counts too: ``conn`` = the daemon's
+accepted-connection sequence, ``line`` = the daemon's sent-protocol-
+line sequence, ``persist`` = the scheduler's queue.json snapshot
+sequence, ``spill`` = the tiered store's spill-write sequence),
+``count`` the value at which the spec fires.  Each spec fires AT MOST ONCE per process: a run that recovers
 from an injected OOM and re-expands the same level must not be
 re-injected forever (mirroring the real world, where the recovery's
 degraded capacity is what prevents the repeat).
@@ -59,7 +71,13 @@ class FaultError(RuntimeError):
     engines' real out-of-memory handlers fire."""
 
 
-KINDS = ("oom", "fpset_fail", "kill", "sigterm", "ckpt_fail")
+KINDS = (
+    "oom", "fpset_fail", "kill", "sigterm", "ckpt_fail",
+    # service-layer kinds (r17): the caller realizes them — the
+    # daemon closes the connection (`drop`), tears a protocol line
+    # (`torn`), or raises :func:`enospc_error` (`enospc`)
+    "drop", "torn", "enospc",
+)
 
 # parse cache keyed on the raw env value + set of fired spec indexes
 # (per process; a changed PTT_FAULT re-arms everything)
@@ -153,4 +171,17 @@ def oom_error(site: str, count: int) -> FaultError:
     return FaultError(
         f"RESOURCE_EXHAUSTED: injected fault oom@{site}:{count} "
         "(PTT_FAULT)"
+    )
+
+
+def enospc_error(site: str, count: int) -> OSError:
+    """The canonical injected disk-full exception — a real
+    ``OSError`` with ``errno.ENOSPC`` so it exercises the *same*
+    handlers as a genuinely full disk (the queue.json persist retry,
+    the spill-tier degradation path)."""
+    import errno
+
+    return OSError(
+        errno.ENOSPC,
+        f"injected fault enospc@{site}:{count} (PTT_FAULT)",
     )

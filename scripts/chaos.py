@@ -1,0 +1,477 @@
+#!/usr/bin/env python
+"""Service-layer chaos drill: a daemon under a randomized fault
+schedule, concurrent retrying clients, and a solo-parity verdict.
+
+The r7/r9 ``PTT_FAULT`` drills proved the ENGINES survive kills, OOMs
+and torn frames; this driver gives the SERVICE layer the same
+treatment (ISSUE 13).  It runs a real ``ServiceDaemon`` (unix socket +
+authenticated TCP) with a seeded, reproducible schedule of service
+faults —
+
+    drop@conn:N      the daemon closes connection N before replying
+                     (the request still processed: the ack-lost shape)
+    torn@line:N      the daemon's N-th sent protocol line is torn
+    enospc@persist:N queue.json snapshot N hits a synthetic disk-full
+
+— while concurrent clients submit jobs over TCP with bearer tokens,
+retrying through the chaos with backoff + jitter and idempotent
+``submit_id`` dedup.  The drill PASSES iff:
+
+- every ADMITTED job completes with state-for-state solo parity
+  (distinct states, diameter, level sizes, verdict, violation gid,
+  full trace);
+- rejected submits (bad token, over quota) were rejected AT THE DOOR
+  — typed errors, no silently queued job — and show up in the
+  ``ptt_admission_*`` metric families;
+- a retried submit never created a second job (admitted == table);
+- the daemon's stream and every per-job stream validate at schema v10.
+
+Reproducibility: every random choice (fault schedule, client jitter)
+derives from ``--seed``.
+
+    python scripts/chaos.py --seed 7 --state-dir /tmp/chaos
+    python scripts/chaos.py --seed 7 --schedule \\
+        "drop@conn:2,torn@line:4,enospc@persist:2"   # pinned faults
+
+The fast tier-1 drill (tests/test_robustness_service.py) calls
+:func:`run_chaos` in-process with a pinned schedule; the randomized
+full run is the slow-marked test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from pulsar_tlaplus_tpu.service.client import (  # noqa: E402
+    AdmissionRejected,
+    AuthError,
+    ServiceClient,
+)
+
+# small, CPU-mesh-cheap engine geometry (the test_service shape)
+GEOM_FAST = dict(
+    sub_batch=64,
+    visited_cap=1 << 10,
+    frontier_cap=1 << 8,
+    max_states=1 << 20,
+    checkpoint_every=1,
+)
+
+# the two drill workloads: one clean pass (compaction producer_on,
+# 1,654 states / diameter 16) and one pinned invariant violation
+# (bookkeeper crash2, 9-state ConfirmedEntryReadable counterexample)
+SMALL_COMPACTION_CFG = """
+CONSTANTS
+    MessageSentLimit = 2
+    CompactionTimesLimit = 2
+    ModelConsumer = FALSE
+    ConsumeTimesLimit = 2
+    KeySpace = {1}
+    ValueSpace = {1}
+    RetainNullKey = TRUE
+    MaxCrashTimes = 1
+    ModelProducer = TRUE
+SPECIFICATION Spec
+INVARIANTS
+"""
+
+BK_CRASH2_CFG = """
+CONSTANTS
+    NumBookies = 3
+    WriteQuorum = 2
+    AckQuorum = 2
+    EntryLimit = 2
+    MaxBookieCrashes = 2
+SPECIFICATION Spec
+INVARIANTS
+    ConfirmedEntryReadable
+"""
+
+TOKENS = {
+    "tokens_v": 1,
+    "tenants": [
+        {"tenant": "alpha", "token": "chaos-alpha-token-1"},
+        {"tenant": "beta", "token": "chaos-beta-token-22"},
+    ],
+}
+
+
+class ChaosFailure(AssertionError):
+    """A drill invariant broken — the report rides the message."""
+
+
+def build_schedule(
+    seed: int, n: int = 4, lo: int = 1, hi: int = 10
+) -> str:
+    """Seeded random service-fault schedule (reproducible: the same
+    seed always yields the same PTT_FAULT string)."""
+    rng = random.Random(seed)
+    kinds = [
+        ("drop", "conn"), ("torn", "line"), ("enospc", "persist"),
+    ]
+    specs = []
+    for _ in range(n):
+        kind, site = rng.choice(kinds)
+        specs.append(f"{kind}@{site}:{rng.randint(lo, hi)}")
+    return ",".join(specs)
+
+
+def _validate_streams(paths: List[str]) -> List[str]:
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(ROOT, "scripts", "check_telemetry_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    errors: List[str] = []
+    for p in paths:
+        errors += mod.validate_stream(p)
+    return errors
+
+
+def _solo_results(pool, workloads) -> Dict[str, object]:
+    """Solo baselines with the pool's exact engine geometry (run
+    BEFORE the daemon starts — the pooled checkers are the same
+    objects the scheduler will use)."""
+    from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+    solos = {}
+    for name, (spec, cfg_path) in workloads.items():
+        tlc_cfg = cfgmod.load(cfg_path)
+        invs = pool.resolve_invariants(spec, tlc_cfg, None)
+        _key, ck = pool.get(spec, tlc_cfg, invs)
+        solos[name] = ck.run()
+    return solos
+
+
+def _assert_parity(job_result: dict, solo, label: str) -> None:
+    checks = [
+        ("distinct_states", solo.distinct_states),
+        ("diameter", solo.diameter),
+        ("level_sizes", [int(x) for x in solo.level_sizes]),
+        ("violation", solo.violation),
+        ("violation_gid", solo.violation_gid),
+        (
+            "trace",
+            [repr(s) for s in solo.trace]
+            if solo.trace is not None
+            else None,
+        ),
+    ]
+    for key, want in checks:
+        got = job_result.get(key)
+        if got != want:
+            raise ChaosFailure(
+                f"{label}: {key} diverged from solo "
+                f"(got {got!r}, want {want!r})"
+            )
+
+
+def run_chaos(
+    state_dir: str,
+    seed: int = 0,
+    schedule: Optional[str] = None,
+    pool=None,
+    geom: Optional[dict] = None,
+    clients: int = 2,
+    jobs_per_client: int = 2,
+    solos: Optional[dict] = None,
+    quota_burst: int = 4,
+    tenant_max_queued: int = 2,
+    slice_s: float = 0.2,
+    timeout_s: float = 600.0,
+    log=lambda m: print(f"chaos: {m}", file=sys.stderr, flush=True),
+) -> dict:
+    """One full drill; returns the report dict, raises
+    :class:`ChaosFailure` on any broken invariant."""
+    from pulsar_tlaplus_tpu.service.scheduler import (
+        CheckerPool,
+        ServiceConfig,
+    )
+    from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+    from pulsar_tlaplus_tpu.utils import faults
+
+    geom = dict(geom or GEOM_FAST)
+    os.makedirs(state_dir, exist_ok=True)
+    cfg_dir = os.path.join(state_dir, "cfgs")
+    os.makedirs(cfg_dir, exist_ok=True)
+    comp_cfg = os.path.join(cfg_dir, "small_compaction.cfg")
+    bk_cfg = os.path.join(cfg_dir, "bk_crash2.cfg")
+    with open(comp_cfg, "w") as f:
+        f.write(SMALL_COMPACTION_CFG)
+    with open(bk_cfg, "w") as f:
+        f.write(BK_CRASH2_CFG)
+    tokens_path = os.path.join(state_dir, "tokens.json")
+    with open(tokens_path, "w") as f:
+        json.dump(TOKENS, f)
+
+    workloads = {
+        "compaction": ("compaction", comp_cfg),
+        "bookkeeper": ("bookkeeper", bk_cfg),
+    }
+    config = ServiceConfig(
+        state_dir=os.path.join(state_dir, "state"),
+        slice_s=slice_s,
+        tcp="127.0.0.1:0",
+        tokens_path=tokens_path,
+        queue_cap=64,
+        tenant_max_queued=tenant_max_queued,
+        **geom,
+    )
+    pool = pool or CheckerPool(config)
+    if solos is None:
+        log("computing solo baselines (pre-daemon, same checkers)")
+        solos = _solo_results(pool, workloads)
+
+    schedule = (
+        schedule if schedule is not None else build_schedule(seed)
+    )
+    log(f"fault schedule: {schedule!r} (seed {seed})")
+    prev_fault = os.environ.get("PTT_FAULT")
+    os.environ["PTT_FAULT"] = schedule
+    faults.reset()
+    fired: List[tuple] = []
+    faults.set_observer(lambda k, s, c: fired.append((k, s, c)))
+
+    report: dict = {
+        "seed": seed,
+        "schedule": schedule,
+        "admitted": [],
+        "rejected": {"auth": 0, "quota": 0, "capacity": 0},
+        "completed": 0,
+        "faults_fired": fired,
+    }
+    daemon = ServiceDaemon(config, pool=pool, log=log)
+    try:
+        daemon.start()
+        addr = f"tcp://127.0.0.1:{daemon.tcp_port}"
+
+        # --- rejection probes (at the door, typed) -----------------
+        bad = ServiceClient(
+            addr, timeout=timeout_s, token="not-a-real-token",
+            retries=2, rng=random.Random(seed ^ 0x5EC),
+        )
+        try:
+            bad.submit("bookkeeper", bk_cfg)
+            raise ChaosFailure("bad token was NOT rejected")
+        except AuthError:
+            report["rejected"]["auth"] += 1
+
+        # quota burst: tenant beta floods past tenant_max_queued —
+        # the overflow must reject, not silently queue.  Admission
+        # legitimately races the scheduler in a live daemon (a claim
+        # or completion between two submits frees a queued slot), so
+        # the burst keeps submitting until a rejection lands:
+        # submits (~ms each once the single-shot faults have fired)
+        # outpace job completions (a full slice), so the queue grows
+        # past the quota within a bounded number of rounds.  The
+        # race-free at-the-door contract is pinned separately by the
+        # frozen-scheduler tier-1 tests.
+        beta = ServiceClient(
+            addr, timeout=timeout_s,
+            token="chaos-beta-token-22", retries=6,
+            rng=random.Random(seed ^ 0xBE7A),
+        )
+        beta_admitted: List[str] = []
+        max_burst = max(quota_burst, 8 * (tenant_max_queued + 1))
+        for k in range(max_burst):
+            try:
+                beta_admitted.append(
+                    beta.submit(
+                        "compaction", comp_cfg,
+                        submit_id=f"beta-burst-{k}",
+                    )
+                )
+            except AdmissionRejected as e:
+                report["rejected"][e.code] = (
+                    report["rejected"].get(e.code, 0) + 1
+                )
+            rejections = (
+                report["rejected"]["quota"]
+                + report["rejected"]["capacity"]
+            )
+            if rejections and k + 1 >= quota_burst:
+                break
+        if (
+            report["rejected"]["quota"]
+            + report["rejected"]["capacity"]
+            == 0
+        ):
+            raise ChaosFailure(
+                f"quota burst of {max_burst} vs quota "
+                f"{tenant_max_queued} produced no rejection"
+            )
+        report["admitted"] += [("compaction", j) for j in beta_admitted]
+
+        # --- concurrent clients through the fault schedule ---------
+        errors: List[str] = []
+        lock = threading.Lock()
+
+        def client_body(ci: int) -> None:
+            cl = ServiceClient(
+                addr, timeout=timeout_s,
+                token="chaos-alpha-token-1", retries=8,
+                rng=random.Random(seed * 1000 + ci),
+            )
+            names = list(workloads)
+            for k in range(jobs_per_client):
+                name = names[(ci + k) % len(names)]
+                spec, cfg_path = workloads[name]
+                try:
+                    jid = cl.submit(
+                        spec, cfg_path,
+                        submit_id=f"c{ci}-j{k}",
+                        priority=(ci + k) % 3,
+                    )
+                    # the dedup pin: an immediate retried submit with
+                    # the SAME submit_id must return the SAME job
+                    again = cl.submit(
+                        spec, cfg_path, submit_id=f"c{ci}-j{k}",
+                    )
+                    if again != jid:
+                        raise ChaosFailure(
+                            f"submit_id c{ci}-j{k} enqueued twice "
+                            f"({jid} then {again})"
+                        )
+                    with lock:
+                        report["admitted"].append((name, jid))
+                except AdmissionRejected as e:
+                    with lock:
+                        report["rejected"][e.code] = (
+                            report["rejected"].get(e.code, 0) + 1
+                        )
+                except Exception as e:  # noqa: BLE001 — collected
+                    with lock:
+                        errors.append(f"client {ci} job {k}: {e!r}")
+
+        threads = [
+            threading.Thread(target=client_body, args=(ci,))
+            for ci in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s)
+        if errors:
+            raise ChaosFailure(f"client errors: {errors}")
+
+        # --- every admitted job completes with solo parity ---------
+        waiter = ServiceClient(
+            addr, timeout=timeout_s, token="chaos-alpha-token-1",
+            retries=8, rng=random.Random(seed ^ 0x3A17),
+        )
+        for name, jid in report["admitted"]:
+            r = waiter.wait(jid, timeout=timeout_s)
+            if r.get("state") != "done" or not r.get("result"):
+                raise ChaosFailure(
+                    f"admitted job {jid} ({name}) ended "
+                    f"{r.get('state')}: {r.get('error')}"
+                )
+            _assert_parity(r["result"], solos[name], f"{name}/{jid}")
+            report["completed"] += 1
+
+        # --- rejections visible in ptt_admission_*, table honest ---
+        metrics_text = waiter.metrics()
+        for needle in (
+            "ptt_admission_admitted_total",
+            "ptt_admission_rejected_total",
+        ):
+            if needle not in metrics_text:
+                raise ChaosFailure(f"{needle} missing from metrics")
+        # the full table is the OPERATOR's view (unix socket): a TCP
+        # tenant's listing is scoped to its own jobs
+        operator = ServiceClient(config.socket_path, timeout=timeout_s)
+        table = operator.status()
+        if len(table) != len(report["admitted"]):
+            raise ChaosFailure(
+                f"job table has {len(table)} entries but "
+                f"{len(report['admitted'])} submits were admitted — "
+                "a rejected submit was silently queued"
+            )
+        alpha_view = waiter.status()
+        if any(j.get("tenant") != "alpha" for j in alpha_view):
+            raise ChaosFailure(
+                "tenant-scoped listing leaked another tenant's jobs: "
+                f"{alpha_view}"
+            )
+    finally:
+        daemon.shutdown()
+        faults.set_observer(None)
+        if prev_fault is None:
+            os.environ.pop("PTT_FAULT", None)
+        else:
+            os.environ["PTT_FAULT"] = prev_fault
+        faults.reset()
+
+    # --- every stream validator-clean at v10 -----------------------
+    streams = [config.telemetry_path]
+    jobs_dir = config.jobs_dir
+    if os.path.isdir(jobs_dir):
+        for jid in os.listdir(jobs_dir):
+            p = os.path.join(jobs_dir, jid, "events.jsonl")
+            if os.path.exists(p):
+                streams.append(p)
+    stream_errors = _validate_streams(streams)
+    if stream_errors:
+        raise ChaosFailure(f"stream violations: {stream_errors}")
+    report["streams_validated"] = len(streams)
+    log(
+        f"PASS: {report['completed']} admitted job(s) solo-exact, "
+        f"rejected {report['rejected']}, "
+        f"{len(fired)} fault(s) fired, "
+        f"{len(streams)} stream(s) validator-clean"
+    )
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="service-layer chaos drill (seeded, reproducible)"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--schedule", default=None,
+        help="pin the PTT_FAULT schedule (default: derived from "
+        "--seed)",
+    )
+    ap.add_argument(
+        "--state-dir", default=None,
+        help="drill scratch dir (default: a fresh temp dir)",
+    )
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--jobs-per-client", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    state_dir = args.state_dir
+    if state_dir is None:
+        import tempfile
+
+        state_dir = tempfile.mkdtemp(prefix="ptt_chaos_")
+    try:
+        run_chaos(
+            state_dir,
+            seed=args.seed,
+            schedule=args.schedule,
+            clients=args.clients,
+            jobs_per_client=args.jobs_per_client,
+            timeout_s=args.timeout,
+        )
+    except ChaosFailure as e:
+        print(f"chaos: FAIL: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

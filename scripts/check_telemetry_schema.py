@@ -46,13 +46,21 @@ boundaries where adjustments applied; r16: v9 run headers carry
 (keys/rows evicted, raw/compressed bytes, transfer seconds, misses
 resolved) are CUMULATIVE per run: the validator cross-checks that
 per-level spill bytes are monotone-cumulative, so a torn or re-based
-spill writer fails loudly — all FIELD_SINCE-gated so
+spill writer fails loudly; r17: v10 run headers carry ``tenant`` —
+the bearer-token-derived tenant, null on standalone runs — and the
+hardened daemon emits ``admission`` (admit/reject/shed/dedup, with
+tenant + reason), ``auth`` (TCP handshake), and ``deadline`` (the
+deadline sweep cancelling an expired job) events — all
+FIELD_SINCE-gated so
 older streams stay clean).  ``--trace``
 validates an exported Perfetto trace file's event structure instead
 (obs/trace.py); ``--ledger`` validates cross-run regression ledger
 files (obs/ledger.py — record structure + digest integrity);
 ``--profile`` validates tuned-profile JSON files (tune/profiles.py —
-format version, engine-known knobs, filename/sig agreement).  Bench
+format version, engine-known knobs, filename/sig agreement);
+``--tokens`` validates daemon tokens.json files (service/auth.py —
+tokens_v, non-empty tenants, unique tokens/tenants, reserved-name
+and token-length rules).  Bench
 rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
@@ -370,6 +378,11 @@ def main(argv=None) -> int:
         "tune output) and validate their structure against the "
         "profile schema (tune/profiles.py)",
     )
+    ap.add_argument(
+        "--tokens", action="store_true",
+        help="treat the .json files as daemon tokens.json files "
+        "(serve --tokens) and validate their shape (service/auth.py)",
+    )
     args = ap.parse_args(argv)
     files = list(args.files)
     if args.all_bench:
@@ -398,6 +411,12 @@ def main(argv=None) -> int:
             from pulsar_tlaplus_tpu.tune.profiles import validate_file
 
             errors += validate_file(p)
+        elif args.tokens:
+            from pulsar_tlaplus_tpu.service.auth import (
+                validate_tokens_file,
+            )
+
+            errors += validate_tokens_file(p)
         else:
             errors += validate_bench_artifact(p)
     for e in errors:

@@ -1,0 +1,821 @@
+"""Open-network daemon hardening (r17, ISSUE 13).
+
+The acceptance bar:
+
+- the CHAOS DRILL: a daemon under injected connection drops, torn
+  protocol lines, and a persist ENOSPC, with concurrent clients
+  retrying through it, completes every ADMITTED job with
+  state-for-state solo parity while over-quota and bad-token submits
+  are rejected with their distinct exit codes and appear in
+  ``ptt_admission_*`` (``scripts/chaos.py``, seeded + reproducible);
+- a retried submit with the same ``submit_id`` never creates a second
+  job — pinned through a real ``drop@conn`` (reply lost, request
+  processed);
+- telemetry v10 streams from the drills are validator-clean, and the
+  v10 gates (``run_header.tenant``, ``admission``/``auth``/
+  ``deadline`` required fields) hold records to their own version;
+- one fast drill per new service fault site (drop/torn/enospc x2),
+  auth accept+reject, quota reject, priority preemption order,
+  deadline cancel — all tier-1; the randomized chaos run slow-marked.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+from pulsar_tlaplus_tpu.models.bookkeeper import (
+    BookkeeperConstants,
+    BookkeeperModel,
+)
+from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+from pulsar_tlaplus_tpu.ref import pyeval as pe
+from pulsar_tlaplus_tpu.service import admission as admmod
+from pulsar_tlaplus_tpu.service import auth as authmod
+from pulsar_tlaplus_tpu.service import jobs as jobmod
+from pulsar_tlaplus_tpu.service.client import (
+    AdmissionRejected,
+    AuthError,
+    ServiceClient,
+    TransportError,
+    backoff_delays,
+    poll_delays,
+)
+from pulsar_tlaplus_tpu.service.scheduler import (
+    CheckerPool,
+    Scheduler,
+    ServiceConfig,
+)
+from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+from pulsar_tlaplus_tpu.utils import faults
+from tests.helpers import SMALL_CONFIGS, tight_hbm_budget
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the test_service engine geometry: small caps, growth exercised,
+# cheap on the CPU mesh — and identical across solo/pool so parity is
+# state-for-state
+GEOM = dict(
+    sub_batch=64,
+    visited_cap=1 << 10,
+    frontier_cap=1 << 8,
+    max_states=1 << 20,
+    checkpoint_every=1,
+)
+
+SMALL_COMPACTION_CFG = """
+CONSTANTS
+    MessageSentLimit = 2
+    CompactionTimesLimit = 2
+    ModelConsumer = FALSE
+    ConsumeTimesLimit = 2
+    KeySpace = {1}
+    ValueSpace = {1}
+    RetainNullKey = TRUE
+    MaxCrashTimes = 1
+    ModelProducer = TRUE
+SPECIFICATION Spec
+INVARIANTS
+"""
+
+BK_CRASH2_CFG = """
+CONSTANTS
+    NumBookies = 3
+    WriteQuorum = 2
+    AckQuorum = 2
+    EntryLimit = 2
+    MaxBookieCrashes = 2
+SPECIFICATION Spec
+INVARIANTS
+    ConfirmedEntryReadable
+"""
+
+TOKENS = {
+    "tokens_v": 1,
+    "tenants": [
+        {"tenant": "alpha", "token": "test-alpha-token-1"},
+        {"tenant": "beta", "token": "test-beta-token-22"},
+    ],
+}
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def checker_mod():
+    return _load_script("check_telemetry_schema")
+
+
+@pytest.fixture(scope="module")
+def chaos_mod():
+    return _load_script("chaos")
+
+
+@pytest.fixture(scope="module")
+def cfg_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cfgs")
+    (d / "small_compaction.cfg").write_text(SMALL_COMPACTION_CFG)
+    (d / "bk_crash2.cfg").write_text(BK_CRASH2_CFG)
+    (d / "tokens.json").write_text(json.dumps(TOKENS))
+    return d
+
+
+def _config(state_dir, **kw) -> ServiceConfig:
+    base = dict(GEOM)
+    base.update(kw)
+    return ServiceConfig(state_dir=str(state_dir), **base)
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    return CheckerPool(
+        _config(tmp_path_factory.mktemp("pool-anchor"))
+    )
+
+
+def _solo(model, invariants):
+    return DeviceChecker(
+        model,
+        invariants=invariants,
+        sub_batch=GEOM["sub_batch"],
+        visited_cap=GEOM["visited_cap"],
+        frontier_cap=GEOM["frontier_cap"],
+        max_states=GEOM["max_states"],
+    ).run()
+
+
+@pytest.fixture(scope="module")
+def solo_compaction():
+    want = pe.check(SMALL_CONFIGS["producer_on"], invariants=())
+    solo = _solo(CompactionModel(SMALL_CONFIGS["producer_on"]), ())
+    assert solo.distinct_states == want.distinct_states == 1654
+    return solo
+
+
+@pytest.fixture(scope="module")
+def solo_bk_crash2():
+    solo = _solo(
+        BookkeeperModel(BookkeeperConstants(max_bookie_crashes=2)),
+        ("ConfirmedEntryReadable",),
+    )
+    assert solo.violation == "ConfirmedEntryReadable"
+    assert len(solo.trace) == 9
+    return solo
+
+
+@pytest.fixture()
+def fault_env():
+    """Set PTT_FAULT for one test, re-arm the spec cache, and always
+    restore afterwards (the faults module is process-global)."""
+    def arm(spec: str):
+        os.environ["PTT_FAULT"] = spec
+        faults.reset()
+
+    prev = os.environ.get("PTT_FAULT")
+    yield arm
+    if prev is None:
+        os.environ.pop("PTT_FAULT", None)
+    else:
+        os.environ["PTT_FAULT"] = prev
+    faults.reset()
+
+
+# ---- auth: tokens.json + constant-time handshake --------------------
+
+
+class TestAuth:
+    def test_tokens_validation(self, tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(TOKENS))
+        assert authmod.validate_tokens_file(str(good)) == []
+        assert authmod.load_tokens(str(good)) == {
+            "test-alpha-token-1": "alpha",
+            "test-beta-token-22": "beta",
+        }
+        for label, obj in {
+            "not-object": [1],
+            "no-version": {"tenants": TOKENS["tenants"]},
+            "newer": {"tokens_v": 99, "tenants": TOKENS["tenants"]},
+            "empty": {"tokens_v": 1, "tenants": []},
+            "short-token": {
+                "tokens_v": 1,
+                "tenants": [{"tenant": "a", "token": "short"}],
+            },
+            "dup-token": {
+                "tokens_v": 1,
+                "tenants": [
+                    {"tenant": "a", "token": "same-token-12345"},
+                    {"tenant": "b", "token": "same-token-12345"},
+                ],
+            },
+            "reserved": {
+                "tokens_v": 1,
+                "tenants": [
+                    {
+                        "tenant": authmod.LOCAL_TENANT,
+                        "token": "whatever-token-1",
+                    }
+                ],
+            },
+        }.items():
+            errs = authmod.validate_tokens_obj(obj, label=label)
+            assert errs, label
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"tokens_v": 1, "tenants": []}))
+        with pytest.raises(ValueError):
+            authmod.load_tokens(str(bad))
+
+    def test_tokens_cli_front_end(self, tmp_path, checker_mod):
+        good = tmp_path / "tokens.json"
+        good.write_text(json.dumps(TOKENS))
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"tokens_v": 1, "tenants": []}))
+        assert checker_mod.main(["--tokens", str(good)]) == 0
+        assert checker_mod.main(["--tokens", str(bad)]) == 1
+
+    def test_authenticate_never_trusts_claims(self):
+        tokens = {"test-alpha-token-1": "alpha"}
+        assert authmod.authenticate(tokens, "test-alpha-token-1") == (
+            "alpha"
+        )
+        assert authmod.authenticate(tokens, "wrong") is None
+        assert authmod.authenticate(tokens, None) is None
+        assert authmod.authenticate({}, "test-alpha-token-1") is None
+
+    def test_tcp_requires_tokens(self, tmp_path, pool):
+        with pytest.raises(ValueError, match="requires --tokens"):
+            ServiceDaemon(
+                _config(tmp_path / "state", tcp="127.0.0.1:0"),
+                pool=pool,
+            )
+
+
+# ---- the TCP transport: accept, reject, tenant attribution ----------
+
+
+def test_tcp_auth_roundtrip_and_reject(
+    tmp_path, pool, cfg_dir, checker_mod
+):
+    """A good token submits over TCP and the derived tenant lands on
+    the job, the job_submit event, and the engine run header (v10);
+    a bad token is rejected with the typed ``auth`` code; the streams
+    validate."""
+    config = _config(
+        tmp_path / "state", slice_s=0.3,
+        tcp="127.0.0.1:0", tokens_path=str(cfg_dir / "tokens.json"),
+    )
+    daemon = ServiceDaemon(config, pool=pool)
+    daemon.start()
+    try:
+        addr = f"tcp://127.0.0.1:{daemon.tcp_port}"
+        with pytest.raises(AuthError):
+            ServiceClient(addr, token="wrong-token", retries=1).submit(
+                "compaction", str(cfg_dir / "small_compaction.cfg"),
+            )
+        cl = ServiceClient(
+            addr, token="test-alpha-token-1", timeout=240.0
+        )
+        jid = cl.submit(
+            "compaction", str(cfg_dir / "small_compaction.cfg"),
+            invariants=[],
+        )
+        r = cl.wait(jid, timeout=240.0)
+        assert r["result"]["distinct_states"] == 1654
+        job = daemon.sched.get(jid)
+        assert job.tenant == "alpha"
+    finally:
+        daemon.shutdown()
+    # tenant end to end: job_submit + auth events in the daemon
+    # stream, tenant on every engine run header, all v10-clean
+    evs = [json.loads(x) for x in open(config.telemetry_path)]
+    assert {
+        e["action"] for e in evs if e["event"] == "auth"
+    } == {"accept", "reject"}
+    sub = [e for e in evs if e["event"] == "job_submit"][0]
+    assert sub["tenant"] == "alpha"
+    assert checker_mod.validate_stream(config.telemetry_path) == []
+    heads = [
+        json.loads(x)
+        for x in open(job.events_path)
+        if '"run_header"' in x
+    ]
+    assert heads and all(h["tenant"] == "alpha" for h in heads)
+    assert checker_mod.validate_stream(job.events_path) == []
+
+
+def test_cli_exit_codes_auth_and_quota(tmp_path, pool, cfg_dir):
+    """The distinct client exit codes: 4 = bad token, 5 = over quota
+    — never 1 (violation) or 2 (transport)."""
+    from pulsar_tlaplus_tpu import cli
+
+    config = _config(
+        tmp_path / "state", slice_s=30.0,
+        tcp="127.0.0.1:0", tokens_path=str(cfg_dir / "tokens.json"),
+        tenant_max_queued=1,
+    )
+    daemon = ServiceDaemon(config, pool=pool)
+    daemon.start()
+    # freeze claiming so the queued quota-filler stays QUEUED — the
+    # overflow decision must not race the scheduler thread
+    daemon.sched._stop.set()
+    try:
+        addr = f"tcp://127.0.0.1:{daemon.tcp_port}"
+        cfg = str(cfg_dir / "small_compaction.cfg")
+        with pytest.raises(SystemExit) as ei:
+            cli.main([
+                "submit", "compaction", cfg,
+                "--socket", addr, "--token", "wrong-token",
+            ])
+        assert ei.value.code == 4
+        # fill the quota, then overflow it
+        cl = ServiceClient(addr, token="test-beta-token-22")
+        cl.submit("compaction", cfg, invariants=[])
+        with pytest.raises(SystemExit) as ei:
+            cli.main([
+                "submit", "compaction", cfg,
+                "--socket", addr, "--token", "test-beta-token-22",
+            ])
+        assert ei.value.code == 5
+        # the contract holds on EVERY subcommand, not just submit: a
+        # bad token on `status` is "fix my token" (4), never "the
+        # daemon is down" (2)
+        with pytest.raises(SystemExit) as ei:
+            cli.main([
+                "status", "--socket", addr, "--token", "wrong-token",
+            ])
+        assert ei.value.code == 4
+    finally:
+        daemon.shutdown()
+
+
+# ---- admission control ----------------------------------------------
+
+
+def test_quota_rejections_never_queue(tmp_path, pool, cfg_dir):
+    """Over-quota and over-capacity submits are rejected AT THE DOOR:
+    typed errors, nothing enqueued, counters + admission events."""
+    config = _config(
+        tmp_path / "state",
+        queue_cap=3, tenant_max_queued=2, tenant_max_states=1 << 21,
+    )
+    sched = Scheduler(config, pool=pool)
+    cfg = str(cfg_dir / "small_compaction.cfg")
+    j1 = sched.submit("compaction", cfg, tenant="alpha")
+    sched.submit("compaction", cfg, tenant="alpha")
+    with pytest.raises(admmod.AdmissionError) as ei:
+        sched.submit("compaction", cfg, tenant="alpha")
+    assert ei.value.code == "quota"
+    assert ei.value.reason == "tenant_queued"
+    # another tenant still fits — then the GLOBAL cap sheds
+    sched.submit("compaction", cfg, tenant="beta")
+    with pytest.raises(admmod.AdmissionError) as ei:
+        sched.submit("compaction", cfg, tenant="beta")
+    assert ei.value.code == "capacity"
+    assert ei.value.reason == "queue_full"
+    # aggregate state budget: each job prices at the service default
+    cfg2 = _config(
+        tmp_path / "state2", tenant_max_states=GEOM["max_states"],
+    )
+    sched2 = Scheduler(cfg2, pool=pool)
+    sched2.submit("compaction", cfg, tenant="alpha")
+    with pytest.raises(admmod.AdmissionError) as ei:
+        sched2.submit("compaction", cfg, tenant="alpha")
+    assert ei.value.reason == "tenant_states"
+    # the unix-socket operator ("local") is exempt from per-tenant
+    # quotas — a pre-r17 local batch sweep must keep queueing freely
+    # (the global queue_cap shed still applies to everyone)
+    sched2.submit("compaction", cfg)
+    sched2.submit("compaction", cfg)
+    # nothing over quota ever entered the table
+    assert len(sched.jobs) == 3
+    snap = sched.admission.snapshot()
+    assert snap["admitted"] == {"alpha": 2, "beta": 1}
+    assert snap["rejected"] == {
+        "alpha/tenant_queued": 1, "beta/queue_full": 1,
+    }
+    # the decisions are telemetry too (the ptt_admission_* source in
+    # file-scrape mode is these events)
+    from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
+
+    text = metrics_mod.render_exposition(
+        metrics_mod.scheduler_metrics(sched)
+    )
+    assert 'ptt_admission_admitted_total{tenant="alpha"} 2' in text
+    assert (
+        'ptt_admission_rejected_total{reason="tenant_queued",'
+        'tenant="alpha"} 1' in text
+    )
+    assert 'ptt_admission_shed_total{tenant="beta"} 1' in text
+    assert j1.tenant == "alpha"
+
+
+# ---- priorities + deadlines -----------------------------------------
+
+
+def test_priority_claim_order_and_preemption(tmp_path, pool, cfg_dir):
+    """(priority, FIFO) claim order, and a waiting higher-priority
+    job preempts the running lower-priority one at its next level
+    boundary (through the existing suspend/resume primitive)."""
+    cfg = str(cfg_dir / "small_compaction.cfg")
+    config = _config(tmp_path / "state", slice_s=30.0)
+    sched = Scheduler(config, pool=pool)
+    jlow = sched.submit("compaction", cfg, invariants=[], priority=0)
+    sched.start()
+    deadline = time.monotonic() + 120.0
+    while jlow.state == jobmod.QUEUED:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    jhigh = sched.submit("compaction", cfg, invariants=[], priority=5)
+    sched.wait(jhigh.job_id, timeout=240.0)
+    sched.wait(jlow.job_id, timeout=240.0)
+    sched.stop(timeout=120.0)
+    assert jlow.state == jhigh.state == jobmod.DONE
+    # the running low-prio job was preempted (not just sliced out:
+    # slice_s is 30 s, far beyond either job's wall)
+    assert jlow.suspends >= 1
+    assert jhigh.suspends == 0
+    assert jhigh.finished_unix < jlow.finished_unix
+    # both still exact
+    assert jlow.result["distinct_states"] == 1654
+    assert jhigh.result["distinct_states"] == 1654
+
+    # claim order within the synchronous drain: high before low, FIFO
+    # within a class
+    config2 = _config(tmp_path / "state2")
+    sched2 = Scheduler(config2, pool=pool)
+    ja = sched2.submit("compaction", cfg, invariants=[], priority=0)
+    jb = sched2.submit("compaction", cfg, invariants=[], priority=2)
+    jc = sched2.submit("compaction", cfg, invariants=[], priority=2)
+    sched2.run_until_idle()
+    order = sorted(
+        (j.started_unix, j.job_id)
+        for j in (ja, jb, jc)
+    )
+    assert [jid for _t, jid in order] == [
+        jb.job_id, jc.job_id, ja.job_id
+    ]
+
+
+def test_deadline_cancels_queued_and_running(
+    tmp_path, pool, cfg_dir, checker_mod
+):
+    """The deadline sweep cancels an expired queued job; a running
+    job's hook cancels it mid-run — both with the honest
+    ``stop_reason="deadline"`` record, a v10 ``deadline`` event, and
+    exit code 3 (truncated, no verdict)."""
+    from pulsar_tlaplus_tpu import cli as climod
+    from pulsar_tlaplus_tpu.obs.telemetry import Telemetry
+
+    cfg = str(cfg_dir / "small_compaction.cfg")
+    config = _config(tmp_path / "state", slice_s=30.0)
+    stream = str(tmp_path / "svc.jsonl")
+    tel = Telemetry(stream)
+    sched = Scheduler(config, pool=pool, telemetry=tel)
+    with pytest.raises(ValueError, match="deadline_s"):
+        sched.submit("compaction", cfg, deadline_s=0.0)
+    # a queued job whose deadline passes before it is claimed
+    jq = sched.submit(
+        "compaction", cfg, invariants=[], deadline_s=1e-3,
+    )
+    time.sleep(0.01)
+    sched.run_until_idle()  # the sweep expires it before any claim
+    assert jq.slices == 0
+    # a running job whose deadline passes mid-run: claim the slice,
+    # then expire the deadline under it — the hook's next level-
+    # boundary poll discards the run (deterministic: no wall racing)
+    jr = sched.submit("compaction", cfg, invariants=[], deadline_s=60.0)
+    job = sched._claim()
+    assert job is jr
+    with sched.cv:
+        jr.deadline_unix = time.time() - 0.01
+    sched._run_slice(jr)
+    tel.close()
+    for j in (jq, jr):
+        assert j.state == jobmod.DONE
+        assert j.result["status"] == "deadline"
+        assert j.result["stop_reason"] == "deadline"
+        assert j.result["truncated"] is True
+        assert json.load(open(j.result_path)) == j.result
+        # exit-code contract: truncated/no-verdict = 3
+        assert climod._service_exit(j.state, j.result, None) == 3
+    evs = [json.loads(x) for x in open(stream)]
+    dl = [e for e in evs if e["event"] == "deadline"]
+    assert {e["job_id"] for e in dl} == {jq.job_id, jr.job_id}
+    assert checker_mod.validate_stream(stream) == []
+
+
+# ---- client resilience ----------------------------------------------
+
+
+def test_backoff_helpers():
+    import random as _random
+
+    rng = _random.Random(7)
+    ds = list(backoff_delays(6, base=0.05, cap=1.0, rng=rng))
+    assert len(ds) == 6
+    assert all(0 <= d <= 1.0 for d in ds)
+    # the envelope doubles until the cap
+    assert ds[5] <= 1.0
+    gen = poll_delays(base=0.05, cap=0.5, rng=_random.Random(3))
+    seq = [next(gen) for _ in range(10)]
+    assert all(0 < d <= 0.5 for d in seq)
+    assert seq[9] >= 0.25  # ramped to the cap's neighborhood
+
+    # two clients with different rngs never sleep in lockstep
+    a = list(backoff_delays(5, rng=_random.Random(1)))
+    b = list(backoff_delays(5, rng=_random.Random(2)))
+    assert a != b
+
+
+def test_drop_conn_retry_dedups_submit(
+    tmp_path, pool, cfg_dir, fault_env
+):
+    """THE dedup pin: the daemon processes a submit but drops the
+    reply (``drop@conn``); the client's retry with the same
+    ``submit_id`` must return the SAME job — one job in the table,
+    one ``dedup`` admission decision."""
+    config = _config(tmp_path / "state", slice_s=0.3)
+    fault_env("drop@conn:1")
+    daemon = ServiceDaemon(config, pool=pool)
+    daemon.start()
+    try:
+        cl = ServiceClient(
+            config.socket_path, timeout=240.0, retries=5,
+        )
+        jid = cl.submit(
+            "compaction", str(cfg_dir / "small_compaction.cfg"),
+            invariants=[], submit_id="pinned-submit",
+        )
+        # a second explicit retry is the same job too
+        assert cl.submit(
+            "compaction", str(cfg_dir / "small_compaction.cfg"),
+            invariants=[], submit_id="pinned-submit",
+        ) == jid
+        assert len(cl.status()) == 1
+        r = cl.wait(jid, timeout=240.0)
+        assert r["result"]["distinct_states"] == 1654
+        snap = daemon.sched.admission.snapshot()
+        assert snap["admitted"] == {"local": 1}
+        assert snap["deduped"]["local"] >= 2
+    finally:
+        daemon.shutdown()
+
+
+def test_torn_line_retries_clean(tmp_path, pool, cfg_dir, fault_env):
+    """``torn@line``: the daemon tears a reply line mid-write; the
+    client sees a protocol error and retries to success."""
+    config = _config(tmp_path / "state")
+    fault_env("torn@line:1")
+    daemon = ServiceDaemon(config, pool=pool)
+    daemon.start()
+    try:
+        cl = ServiceClient(config.socket_path, retries=5)
+        pong = cl.ping()  # first reply line is torn; retry succeeds
+        assert pong["pid"] == os.getpid()
+        # retries exhausted surfaces as TransportError (exit 2), not
+        # a violation: arm more tears than the budget
+        fault_env(",".join(f"torn@line:{i}" for i in range(2, 9)))
+        with pytest.raises(TransportError):
+            ServiceClient(config.socket_path, retries=2).ping()
+    finally:
+        daemon.shutdown()
+
+
+def test_enospc_persist_retries_and_daemon_survives(
+    tmp_path, pool, cfg_dir, fault_env
+):
+    """``enospc@persist``: a queue.json snapshot hits disk-full; the
+    retry (after freeing the half-written tmp) succeeds, the daemon
+    keeps serving, and the final queue.json parses."""
+    config = _config(tmp_path / "state")
+    fault_env("enospc@persist:1")
+    sched = Scheduler(config, pool=pool)
+    job = sched.submit(
+        "compaction", str(cfg_dir / "small_compaction.cfg"),
+        invariants=[],
+    )
+    sched.run_until_idle()
+    assert job.state == jobmod.DONE
+    assert job.result["distinct_states"] == 1654
+    assert sched.persist_failures == 0  # the retry absorbed it
+    snap = json.load(open(config.queue_path))
+    assert {d["state"] for d in snap["jobs"]} == {jobmod.DONE}
+    assert not [
+        f for f in os.listdir(config.state_dir)
+        if ".tmp." in f
+    ]
+
+
+# ---- torn-queue recovery (satellite) --------------------------------
+
+
+def test_torn_queue_recovery_rebuilds_from_job_dirs(
+    tmp_path, pool, cfg_dir, solo_compaction
+):
+    """``serve --recover`` with a forged half-written queue.json
+    quarantines it and rebuilds the queue from the per-job dirs —
+    jobs complete with solo parity, submit_id dedup survives."""
+    cfg = str(cfg_dir / "small_compaction.cfg")
+    config = _config(tmp_path / "state")
+    sched = Scheduler(config, pool=pool)
+    j1 = sched.submit(
+        "compaction", cfg, invariants=[], submit_id="recover-me",
+    )
+    j2 = sched.submit("compaction", cfg, invariants=[])
+    # forge the torn write: a half-written queue.json
+    raw = open(config.queue_path).read()
+    with open(config.queue_path, "w") as f:
+        f.write(raw[: len(raw) // 2])
+
+    sched2 = Scheduler(config, pool=pool)
+    assert sched2.recover() == 2
+    corrupt = [
+        f for f in os.listdir(config.state_dir)
+        if f.startswith("queue.json.corrupt.")
+    ]
+    assert len(corrupt) == 1
+    # the quarantined bytes are the torn original (forensics intact)
+    assert open(
+        os.path.join(config.state_dir, corrupt[0])
+    ).read() == raw[: len(raw) // 2]
+    # dedup index rebuilt from the job dirs
+    assert sched2.submit(
+        "compaction", cfg, submit_id="recover-me",
+    ).job_id == j1.job_id
+    sched2.run_until_idle()
+    r1, r2 = sched2.get(j1.job_id), sched2.get(j2.job_id)
+    assert r1.state == r2.state == jobmod.DONE
+    assert r1.result["distinct_states"] == solo_compaction.distinct_states
+    assert r1.result["level_sizes"] == [
+        int(x) for x in solo_compaction.level_sizes
+    ]
+    # a fresh queue.json took the torn one's place
+    assert json.load(open(config.queue_path))["jobs"]
+    # missing queue.json is still a clean no-op
+    assert Scheduler(
+        _config(tmp_path / "other"), pool=pool
+    ).recover() == 0
+
+
+# ---- spill-tier ENOSPC degradation (satellite) ----------------------
+
+
+def test_spill_enospc_degrades_honestly(tmp_path, fault_env, checker_mod):
+    """``enospc@spill``: the async spill worker hits disk-full; the
+    run STOPS EVICTING and truncates with ``stop_reason=
+    "spill_enospc"`` (counts exact up to the stop — the in-RAM tiers
+    kept dedup sound), the ``spill`` record carries ``degraded``, no
+    poisoned frame is left, and the stream validates."""
+    c = SMALL_CONFIGS["producer_on"]
+
+    def mk(**kw):
+        kw.setdefault("invariants", ())
+        kw.setdefault("check_deadlock", False)
+        kw.setdefault("sub_batch", 64)
+        kw.setdefault("visited_cap", 1 << 9)
+        kw.setdefault("frontier_cap", 1 << 9)
+        return DeviceChecker(CompactionModel(c), **kw)
+
+    budget = tight_hbm_budget(lambda b: mk(hbm_budget=b))
+    frame = str(tmp_path / "ck.npz")
+    stream = str(tmp_path / "run.jsonl")
+    fault_env("enospc@spill:1")
+    ck = mk(
+        hbm_budget=budget, checkpoint_path=frame,
+        checkpoint_every=2, telemetry=stream,
+    )
+    r = ck.run()
+    assert r.truncated and r.stop_reason == "spill_enospc"
+    assert 0 < r.distinct_states < 1654
+    assert ck.last_stats["spill_degraded"] is True
+    assert ck.tstore.degraded
+    evs = [json.loads(x) for x in open(stream)]
+    degraded = [
+        e for e in evs if e["event"] == "spill" and e.get("degraded")
+    ]
+    assert degraded
+    assert checker_mod.validate_stream(stream) == []
+    # a degraded store never anchors a manifest
+    with pytest.raises(ValueError, match="degraded"):
+        ck.tstore.manifest()
+
+
+# ---- v10 schema gates -----------------------------------------------
+
+
+def test_v10_validator_gates(tmp_path, checker_mod):
+    """v10 requires run_header.tenant and the admission/auth/deadline
+    fields — but holds older records only to their own version."""
+    def rec(seq, t, event, v=10, **kw):
+        base = {
+            "v": v, "event": event, "t": t, "run_id": "r", "seq": seq,
+        }
+        base.update(kw)
+        return base
+
+    header = dict(
+        engine="device_bfs", visited_impl="fpset", config_sig="sig",
+        profile_sig=None, hbm_budget=None,
+    )
+    good = tmp_path / "good.jsonl"
+    good.write_text(
+        "\n".join(
+            json.dumps(r)
+            for r in [
+                rec(0, 0.1, "run_header", tenant=None, **header),
+                rec(1, 0.2, "admission", action="admit",
+                    tenant="alpha"),
+                rec(2, 0.3, "auth", action="reject"),
+                rec(3, 0.4, "deadline", job_id="j1"),
+                # a v9 header without tenant stays clean
+                rec(4, 0.5, "run_header", v=9, **header),
+            ]
+        )
+        + "\n"
+    )
+    assert checker_mod.validate_stream(str(good)) == []
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        "\n".join(
+            json.dumps(r)
+            for r in [
+                rec(0, 0.1, "run_header", **header),  # no tenant @v10
+                rec(1, 0.2, "admission", action="reject"),  # no tenant
+                rec(2, 0.3, "deadline"),  # no job_id
+            ]
+        )
+        + "\n"
+    )
+    errs = checker_mod.validate_stream(str(bad))
+    assert len(errs) == 3
+    assert any("tenant" in e and "run_header" in e for e in errs)
+    assert any("admission" in e for e in errs)
+    assert any("deadline" in e for e in errs)
+
+
+# ---- THE chaos drill (tier-1 fast; randomized slow) -----------------
+
+
+@pytest.fixture(scope="module")
+def chaos_solos(solo_compaction, solo_bk_crash2):
+    return {
+        "compaction": solo_compaction,
+        "bookkeeper": solo_bk_crash2,
+    }
+
+
+def test_chaos_drill_fast(
+    tmp_path, pool, chaos_mod, chaos_solos, fault_env
+):
+    """The acceptance drill, pinned schedule: a daemon under a
+    connection drop, a torn protocol line, and a persist ENOSPC, with
+    two concurrent retrying clients — every admitted job completes
+    with state-for-state solo parity, over-quota and bad-token
+    submits are rejected at the door and appear in ptt_admission_*,
+    and every stream is v10-validator-clean."""
+    report = chaos_mod.run_chaos(
+        str(tmp_path / "chaos"),
+        seed=1,
+        schedule="drop@conn:2,torn@line:5,enospc@persist:2",
+        pool=pool,
+        geom=GEOM,
+        clients=2,
+        jobs_per_client=1,
+        solos=chaos_solos,
+        timeout_s=300.0,
+    )
+    assert report["completed"] == len(report["admitted"]) >= 3
+    assert report["rejected"]["auth"] == 1
+    assert report["rejected"]["quota"] >= 1
+    assert report["streams_validated"] >= 3
+
+
+@pytest.mark.slow
+def test_chaos_drill_randomized(
+    tmp_path, pool, chaos_mod, chaos_solos, fault_env
+):
+    """The full randomized drill: seeded fault schedules, more
+    clients/jobs — reproduce any failure with the printed seed."""
+    for seed in (3, 11):
+        report = chaos_mod.run_chaos(
+            str(tmp_path / f"chaos{seed}"),
+            seed=seed,
+            pool=pool,
+            geom=GEOM,
+            clients=3,
+            jobs_per_client=2,
+            solos=chaos_solos,
+            timeout_s=540.0,
+        )
+        assert report["completed"] == len(report["admitted"])

@@ -317,8 +317,17 @@ def test_recover_edge_cases(tmp_path, pool):
     os.makedirs(config.state_dir, exist_ok=True)
     with open(config.queue_path, "w") as f:
         f.write("{not json")
-    with pytest.raises(ValueError, match="unreadable queue state"):
-        Scheduler(config, pool=pool).recover()
+    # r17 torn-queue recovery: a corrupt queue.json is QUARANTINED
+    # and the queue rebuilt from the job dirs (none here) — never a
+    # crash (tests/test_robustness_service.py drills the full path)
+    assert Scheduler(config, pool=pool).recover() == 0
+    assert not os.path.exists(config.queue_path) or json.load(
+        open(config.queue_path)
+    )["jobs"] == []
+    assert [
+        f for f in os.listdir(config.state_dir)
+        if f.startswith("queue.json.corrupt.")
+    ]
 
 
 def test_recover_resumes_first_slice_frame(
