@@ -269,10 +269,355 @@ def load_metrics_records(metrics_path):
     return recs
 
 
+def artifact_skeleton() -> dict:
+    """Every bench_schema-9 required key, None-filled — the simulate
+    and matrix paths fill what applies and stay validator-clean
+    (scripts/check_telemetry_schema.py BENCH_KEYS_V9: keys are
+    REQUIRED, values may be null where the mode has no measurement)."""
+    keys = (
+        "metric", "value", "unit", "vs_baseline",
+        "vs_baseline_definition", "distinct_states", "levels",
+        "compile_warmup_s", "stop_reason", "truncated",
+        "hbm_recovered", "ckpt_frames", "ckpt_bytes", "ckpt_write_s",
+        "ckpt_retries", "fpset_flushes", "fpset_probe_rounds",
+        "fpset_avg_probe_rounds", "fpset_failures", "fpset_occupancy",
+        "fpset_valid_lanes", "fpset_max_probe_rounds", "visited_impl",
+        "max_states", "stats_fetches", "compact_impl", "fuse",
+        "dispatches_per_level", "work_expand_rows", "work_probe_lanes",
+        "work_compact_elems", "work_append_rows", "work_groups",
+        "hbm_budget", "spill_bytes_per_state", "spill_overlap_ratio",
+        "walks_per_sec", "steps_per_state",
+    )
+    d = {k: None for k in keys}
+    d["bench_schema"] = 9
+    return d
+
+
+# ---------------------------------------------------------- simulate
+
+# the simulation bench shape: wide enough to keep the device busy,
+# shallow enough that a CPU-mesh differential finishes in seconds
+SIM_BENCH_KW = dict(n_walkers=4096, depth=64)
+
+
+def run_sim_bench(args) -> None:
+    """``--mode simulate``: the streaming walker swarm on the scaled
+    compaction config under the time budget; one bench_schema-9 JSON
+    line (walks_per_sec / steps_per_state are the headline keys the
+    ledger gates — docs/simulation.md)."""
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+    from pulsar_tlaplus_tpu.sim.engine import StreamingSimulator
+
+    c = scaled_config()
+    model = CompactionModel(c)
+    cleanup_stale_streams(args.telemetry_path)
+    if args.telemetry == _DEFAULT_TELEMETRY:
+        args.telemetry = os.path.join(
+            args.telemetry_path,
+            f"bench_telemetry_{os.getpid()}.jsonl",
+        )
+        try:
+            os.remove(args.telemetry)
+        except OSError:
+            pass
+    sim = StreamingSimulator(
+        model,
+        n_walkers=args.walkers or SIM_BENCH_KW["n_walkers"],
+        depth=args.depth or SIM_BENCH_KW["depth"],
+        segment_len=args.segment,
+        seed=args.sim_seed,
+        max_steps=args.sim_steps,
+        time_budget_s=None if args.sim_steps else args.budget_s,
+        telemetry=args.telemetry,
+        heartbeat_s=args.progress_every,
+        progress=True,
+        checkpoint_path=args.checkpoint,
+    )
+    compile_s = sim.warmup()
+    print(f"compile warmup: {compile_s:.1f}s", file=sys.stderr)
+    r = sim.run(resume=args.recover)
+    print(
+        f"sim: {r.steps} steps / {r.states_visited} states / "
+        f"{r.walks} walks in {r.wall_s:.1f}s "
+        f"({r.steps_per_sec:.0f} steps/s, {r.walks_per_sec:.1f} "
+        f"walks/s)",
+        file=sys.stderr,
+    )
+    d = artifact_skeleton()
+    d.update(
+        metric="simulation steps/sec on scaled compaction.tla "
+        "(|Keys|=8, |Msgs|=64, producer modeled; streaming walker "
+        "swarm, TypeSafe + CompactionHorizonCorrectness checked "
+        "every step)",
+        value=round(r.steps_per_sec, 1),
+        unit="sim steps/sec/chip",
+        vs_baseline_definition="none (simulation has no native "
+        "baseline; walks_per_sec is the headline)",
+        mode="simulate",
+        engine="sim r18 (streaming walker swarm: segmented lax.scan "
+        "rollouts, functional PRNG, in-kernel counters, sampled-"
+        "duplicate estimator)",
+        compile_warmup_s=round(compile_s, 1),
+        stop_reason=r.stop_reason,
+        truncated=r.truncated,
+        telemetry=args.telemetry,
+        checkpoint=args.checkpoint,
+        walks_per_sec=r.walks_per_sec,
+        steps_per_state=(
+            round(r.steps / r.states_visited, 4)
+            if r.states_visited
+            else None
+        ),
+        steps_per_sec=r.steps_per_sec,
+        states_per_sec=r.states_per_sec,
+        sim_walkers=r.n_walkers,
+        sim_depth=r.depth,
+        sim_seed=args.sim_seed,
+        sim_steps=r.steps,
+        sim_states=r.states_visited,
+        sim_walks=r.walks,
+        sim_segments=r.segments,
+        sim_violations=sim.last_stats.get("sim_violations"),
+        sim_dup_ratio_est=r.dup_ratio_est,
+        stats_fetches=sim.last_stats.get("stats_fetches"),
+        ckpt_frames=sim.last_stats.get("ckpt_frames"),
+        ckpt_bytes=sim.last_stats.get("ckpt_bytes"),
+        ckpt_write_s=sim.last_stats.get("ckpt_write_s"),
+        ckpt_retries=sim.last_stats.get("ckpt_retries"),
+        profile_sig=sim.profile_sig,
+    )
+    print(json.dumps(d))
+
+
+# ------------------------------------------------------------- matrix
+
+# Declared constant-scaling axes per registry spec (ISSUE 14 satellite:
+# |Keys|, |Msgs|, EntryLimit, broker/cluster counts) at shapes small
+# enough that every point exhausts on the CPU mesh in seconds.  Each
+# point is one ledger-ingestable bench_schema-9 artifact; `cli.py
+# ledger compare` renders the scaling table between any two points.
+def matrix_axes():
+    from pulsar_tlaplus_tpu.models.bookkeeper import BookkeeperConstants
+    from pulsar_tlaplus_tpu.models.georeplication import GeoConstants
+    from pulsar_tlaplus_tpu.models.subscription import (
+        SubscriptionConstants,
+    )
+    from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+    compaction_base = Constants(
+        message_sent_limit=3, compaction_times_limit=2, num_keys=2,
+        num_values=1, max_crash_times=1,
+    )
+    return {
+        "compaction": (
+            compaction_base,
+            (
+                ("num_keys", (1, 2, 3)),
+                ("message_sent_limit", (2, 3, 4)),
+            ),
+        ),
+        "bookkeeper": (
+            BookkeeperConstants(),
+            (
+                ("entry_limit", (1, 2, 3)),
+                ("num_bookies", (3, 4)),
+            ),
+        ),
+        "georeplication": (
+            GeoConstants(
+                num_clusters=2, publish_limit=2,
+                max_replicator_crashes=1,
+            ),
+            (
+                ("num_clusters", (2, 3)),
+                ("publish_limit", (1, 2)),
+            ),
+        ),
+        "subscription": (
+            SubscriptionConstants(message_limit=2, max_crash_times=1),
+            (
+                ("message_limit", (1, 2, 3)),
+            ),
+        ),
+    }
+
+
+def _matrix_model(spec: str, constants):
+    from pulsar_tlaplus_tpu.models import bookkeeper as bk
+    from pulsar_tlaplus_tpu.models import georeplication as geo
+    from pulsar_tlaplus_tpu.models import subscription as subm
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+
+    return {
+        "compaction": CompactionModel,
+        "bookkeeper": bk.BookkeeperModel,
+        "georeplication": geo.GeoreplicationModel,
+        "subscription": subm.SubscriptionModel,
+    }[spec](constants)
+
+
+def run_matrix(args) -> None:
+    """``--matrix``: sweep the declared constant axes, one exhaustive
+    device-engine run + one bench_schema-9 artifact per point, all
+    ingested into ``--matrix-ledger`` when given.  Prints one JSON
+    summary line."""
+    import dataclasses
+
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    out_dir = args.matrix_out
+    os.makedirs(out_dir, exist_ok=True)
+    axes = matrix_axes()
+    specs = args.matrix_spec or sorted(axes)
+    points = []
+    for spec in specs:
+        if spec not in axes:
+            sys.exit(
+                f"bench: unknown --matrix-spec {spec!r} "
+                f"(known: {sorted(axes)})"
+            )
+        base, spec_axes = axes[spec]
+        for axis, values in spec_axes:
+            for v in values:
+                if args.matrix_limit and len(points) >= args.matrix_limit:
+                    break
+                points.append((spec, base, axis, v))
+    results = []
+    for spec, base, axis, v in points:
+        constants = dataclasses.replace(base, **{axis: v})
+        try:
+            constants.validate()
+        except (AttributeError, ValueError):
+            pass  # models re-validate at construction
+        try:
+            model = _matrix_model(spec, constants)
+        except ValueError as e:
+            print(
+                f"matrix: {spec} {axis}={v}: invalid binding ({e}); "
+                "skipped", file=sys.stderr,
+            )
+            continue
+        t0 = time.time()
+        ck = DeviceChecker(
+            model, sub_batch=256, visited_cap=1 << 13,
+            frontier_cap=1 << 11, max_states=args.max_states,
+        )
+        r = ck.run()
+        wall = time.time() - t0
+        d = artifact_skeleton()
+        d.update(
+            metric=f"constant-scaling matrix point: {spec} {axis}={v} "
+            "(exhaustive device BFS)",
+            value=round(r.states_per_sec, 1),
+            unit="states/sec/chip",
+            mode="check",
+            vs_baseline_definition="none (matrix point)",
+            engine="device_bfs (matrix point)",
+            visited_impl="fpset",
+            compact_impl="logshift",
+            fuse=ck.fuse,
+            matrix_spec=spec,
+            matrix_axis=axis,
+            matrix_value=v,
+            config_sig=repr(constants),
+            distinct_states=r.distinct_states,
+            levels=r.diameter,
+            compile_warmup_s=0.0,
+            stop_reason=r.stop_reason,
+            truncated=r.truncated,
+            hbm_recovered=getattr(r, "hbm_recovered", 0),
+            max_states=args.max_states,
+            wall_s=round(wall, 2),
+            states_per_sec=round(r.states_per_sec, 1),
+        )
+        name = f"BENCH_matrix_{spec}_{axis}_{v}.json"
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+        print(
+            f"matrix: {spec} {axis}={v}: {r.distinct_states} states, "
+            f"diam {r.diameter}, {r.states_per_sec:.0f} st/s -> {path}",
+            file=sys.stderr,
+        )
+        results.append(
+            {
+                "spec": spec, "axis": axis, "value": v,
+                "distinct_states": r.distinct_states,
+                "diameter": r.diameter,
+                "states_per_sec": round(r.states_per_sec, 1),
+                "artifact": path,
+            }
+        )
+    if args.matrix_ledger:
+        from pulsar_tlaplus_tpu.obs import ledger
+
+        recs = [
+            ledger.record_from_file(p["artifact"]) for p in results
+        ]
+        added = ledger.append(args.matrix_ledger, recs)
+        print(
+            f"matrix: ingested {added} point(s) into "
+            f"{args.matrix_ledger}",
+            file=sys.stderr,
+        )
+    print(json.dumps({"matrix": results, "bench_schema": 9}))
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(
         description="headline bench: distinct states/sec on the scaled "
         "compaction model (one JSON line on stdout)"
+    )
+    ap.add_argument(
+        "--mode", choices=["check", "simulate"], default="check",
+        help="workload: 'check' (exhaustive BFS, the headline bench) "
+        "or 'simulate' (the streaming walker swarm — walks/s + "
+        "steps/s under the time budget; docs/simulation.md)",
+    )
+    ap.add_argument(
+        "--walkers", type=int, default=None,
+        help="with --mode simulate: walker swarm width (default 4096)",
+    )
+    ap.add_argument(
+        "--depth", type=int, default=None,
+        help="with --mode simulate: steps per behavior (default 64)",
+    )
+    ap.add_argument(
+        "--segment", type=int, default=None,
+        help="with --mode simulate: steps per dispatch",
+    )
+    ap.add_argument(
+        "--sim-seed", dest="sim_seed", type=int, default=0,
+        help="with --mode simulate: PRNG seed",
+    )
+    ap.add_argument(
+        "--sim-steps", dest="sim_steps", type=int, default=None,
+        help="with --mode simulate: total step budget (overrides the "
+        "time budget — the deterministic bench shape)",
+    )
+    ap.add_argument(
+        "--matrix", action="store_true",
+        help="constant-scaling bench matrix: sweep the declared "
+        "constant axes (|Keys|, |Msgs|, EntryLimit, broker counts) "
+        "at small shapes, one ledger-ingestable artifact per point",
+    )
+    ap.add_argument(
+        "--matrix-out", default="bench_matrix", metavar="DIR",
+        help="with --matrix: artifact output directory",
+    )
+    ap.add_argument(
+        "--matrix-spec", action="append", default=None,
+        help="with --matrix: restrict to this spec (repeatable; "
+        "default: all four registry specs)",
+    )
+    ap.add_argument(
+        "--matrix-limit", type=int, default=None, metavar="N",
+        help="with --matrix: cap the number of points (smoke runs)",
+    )
+    ap.add_argument(
+        "--matrix-ledger", default=None, metavar="FILE",
+        help="with --matrix: ingest every point into this ledger",
     )
     ap.add_argument(
         "--max-states", type=int, default=MAX_STATES,
@@ -392,6 +737,10 @@ def main(argv=None):
     import jax
 
     args = parse_args(argv)
+    if args.matrix:
+        return run_matrix(args)
+    if args.mode == "simulate":
+        return run_sim_bench(args)
     c = scaled_config()
     dev = jax.devices()[0]
     print(f"bench device: {dev}", file=sys.stderr)
@@ -662,8 +1011,14 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # efficiency signal); schema 8 (r16) adds the
                 # tiered-store budget + spill economy keys
                 # (hbm_budget, spill_bytes_per_state,
-                # spill_overlap_ratio — null on untiered runs)
-                "bench_schema": 8,
+                # spill_overlap_ratio — null on untiered runs);
+                # schema 9 (r18) adds the workload mode plus the
+                # swarm-simulation throughput keys (walks_per_sec,
+                # steps_per_state — null on check-mode runs)
+                "bench_schema": 9,
+                "mode": "check",
+                "walks_per_sec": None,
+                "steps_per_state": None,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2

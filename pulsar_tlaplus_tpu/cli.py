@@ -272,6 +272,61 @@ def _report_liveness(prop, args, lres) -> int:
     return 0 if lres.holds else 1
 
 
+def _report_simulation(sres, constants, checkpoint=None) -> int:
+    """TLC-``-simulate``-shaped report + exit code (0 clean, 1
+    violation, 3 interrupted — an interrupted walk stream carries no
+    conclusion and resumes with -recover)."""
+    from pulsar_tlaplus_tpu.utils.render import render_trace
+
+    if sres.violation:
+        print(f"Error: Invariant {sres.violation} is violated.")
+        print("The behavior up to this point is:")
+        print(render_trace(sres.trace, sres.trace_actions, constants))
+        if sres.verified is False:
+            print(
+                "WARNING: the replayed behavior FAILED independent "
+                "re-verification — report this as an engine bug."
+            )
+    print(
+        f"Simulation: {sres.n_walkers} walkers of depth {sres.depth} "
+        f"({sres.states_visited} states visited, {sres.steps} steps, "
+        f"{sres.walks} completed walks)."
+    )
+    print(
+        f"Finished in {sres.wall_s:.1f}s ({sres.steps_per_sec:,.0f} "
+        f"steps/sec, {sres.walks_per_sec:,.1f} walks/sec)"
+        + (
+            f"; sampled duplicate ratio ~{sres.dup_ratio_est:.1%}."
+            if sres.dup_ratio_est is not None
+            else "."
+        )
+    )
+    if sres.violation:
+        return 1
+    if sres.truncated:
+        if sres.stop_reason == "preempted" and checkpoint and (
+            os.path.exists(checkpoint)
+        ):
+            print(
+                "WARNING: simulation preempted (SIGTERM/SIGINT) — a "
+                "resumable frame is on disk; continue the identical "
+                "walk stream with -recover."
+            )
+        else:
+            print(
+                "WARNING: simulation interrupted "
+                f"({sres.stop_reason or 'unknown'}) — the walk "
+                "stream did not reach its budget."
+            )
+        return 3
+    print(
+        "No violation found within the simulation budget "
+        f"(stop reason: {sres.stop_reason}); simulation is NOT "
+        "exhaustive — absence of violations is inconclusive."
+    )
+    return 0
+
+
 def _check_properties(args, model, properties, rc):
     """Check cfg PROPERTIES after a clean safety pass (TLC checks
     temporal properties from the same run); shared by the registry and
@@ -386,15 +441,6 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             "(use -profile DIR to trace the whole check)",
             file=sys.stderr,
         )
-    if (args.telemetry or args.progress) and args.simulate:
-        # flags that do nothing must say so, not silently drop (the
-        # BFS + liveness engines are the telemetry emitters today)
-        print(
-            "tpu-tlc: note: -telemetry/-progress are not wired into "
-            "the simulation engine yet; no stream or heartbeat will "
-            "be produced for this run",
-            file=sys.stderr,
-        )
     if args.liveness_property:
         from pulsar_tlaplus_tpu.engine.liveness import LivenessChecker
 
@@ -425,26 +471,35 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             sys.exit(f"tpu-tlc: {e}")
         return _report_liveness(args.liveness_property, args, lres)
     if args.simulate:
-        from pulsar_tlaplus_tpu.engine.simulate import Simulator
+        # the streaming swarm engine (sim/, round 18): full telemetry,
+        # heartbeat, checkpoint/resume, and tuned-profile support —
+        # the legacy one-round semantics are the default budget
+        from pulsar_tlaplus_tpu.sim.engine import StreamingSimulator
 
         try:
-            sres = Simulator(
+            sim = StreamingSimulator(
                 model,
                 invariants=invariants,
                 n_walkers=args.simulate,
                 depth=args.depth,
-            ).run()
+                segment_len=args.segment,
+                seed=args.sim_seed,
+                max_steps=args.sim_steps,
+                checkpoint_path=args.checkpoint,
+                telemetry=args.telemetry,
+                heartbeat_s=args.progress,
+                progress=True,
+                profile=_profile_arg(args),
+            )
+            sres = sim.run(resume=args.recover)
+        except FileNotFoundError:
+            sys.exit(
+                "tpu-tlc: -recover needs an existing -checkpoint file "
+                f"(got: {args.checkpoint})"
+            )
         except (ValueError, RuntimeError) as e:
             sys.exit(f"tpu-tlc: {e}")
-        if sres.violation:
-            print(f"Error: Invariant {sres.violation} is violated.")
-            print("The behavior up to this point is:")
-            print(render_trace(sres.trace, sres.trace_actions, constants))
-        print(
-            f"Simulation: {sres.n_walkers} behaviors of depth {sres.depth} "
-            f"({sres.states_visited} states visited)."
-        )
-        return 1 if sres.violation else 0
+        return _report_simulation(sres, constants, args.checkpoint)
     if args.sharded and (
         args.sharded_engine == "device"
         and args.sharded_dedup == "sort"
@@ -638,10 +693,16 @@ def _client_fail(op: str, e) -> None:
 def _print_job_line(j: dict) -> None:
     extra = ""
     if j.get("state") == "done":
-        extra = (
-            f"  {j.get('status', '?')} "
-            f"{j.get('distinct_states', '?')} states"
-        )
+        if j.get("mode") == "simulate":
+            extra = (
+                f"  {j.get('status', '?')} "
+                f"{j.get('steps', '?')} sim steps"
+            )
+        else:
+            extra = (
+                f"  {j.get('status', '?')} "
+                f"{j.get('distinct_states', '?')} states"
+            )
     elif j.get("error"):
         extra = f"  {j['error'][:80]}"
     print(
@@ -680,10 +741,18 @@ def _report_job_result(job_id: str, state: str, result, error) -> int:
                     )
                 ):
                     print(f"  {i + 1}: [{a}] {s}")
-        print(
-            f"{result.get('distinct_states')} distinct states found, "
-            f"search depth (diameter) {result.get('diameter')}."
-        )
+        if result.get("mode") == "simulate":
+            print(
+                f"Simulation: {result.get('steps')} steps, "
+                f"{result.get('states_visited')} states visited, "
+                f"{result.get('walks')} completed walks."
+            )
+        else:
+            print(
+                f"{result.get('distinct_states')} distinct states "
+                f"found, search depth (diameter) "
+                f"{result.get('diameter')}."
+            )
         print(
             f"Job {job_id} finished in {result.get('wall_s')}s over "
             f"{result.get('slices')} slice(s) "
@@ -754,6 +823,19 @@ def _cmd_serve(args) -> int:
 def _cmd_submit(args) -> int:
     from pulsar_tlaplus_tpu.service.client import ServiceError
 
+    sim = None
+    if args.mode == "simulate":
+        sim = {
+            k: v
+            for k, v in (
+                ("n_walkers", args.walkers),
+                ("depth", args.depth),
+                ("segment_len", args.segment),
+                ("seed", args.sim_seed),
+                ("max_steps", args.sim_steps),
+            )
+            if v is not None
+        }
     cl = _service_client(args)
     try:
         jid = cl.submit(
@@ -765,6 +847,8 @@ def _cmd_submit(args) -> int:
             priority=args.priority,
             deadline_s=args.deadline_s,
             submit_id=args.submit_id,
+            mode=args.mode,
+            sim=sim,
         )
     except (ServiceError, OSError) as e:
         # distinct exit codes for rejected-at-the-door (docs/
@@ -1135,6 +1219,45 @@ def _cmd_tune(args) -> int:
     def log(msg: str) -> None:
         print(f"tpu-tlc tune: {msg}", file=sys.stderr, flush=True)
 
+    def _ingest_tune_streams() -> None:
+        """Ingest the measured runs' telemetry streams into --ledger
+        (shared by the check and simulate branches)."""
+        if not (args.ledger and stream_dir):
+            return
+        import glob as globmod
+
+        recs = []
+        for p in sorted(
+            globmod.glob(os.path.join(stream_dir, "tune_*.jsonl"))
+        ):
+            try:
+                recs.append(ledger.record_from_file(p))
+            except (OSError, ValueError, json.JSONDecodeError):
+                continue
+        added = ledger.append(args.ledger, recs)
+        print(f"ingested {added} measured run(s) into {args.ledger}")
+
+    if args.mode == "simulate":
+        try:
+            profile, rows = tune_search.tune_sim(
+                model,
+                invariants=invariants,
+                spec_label=module,
+                depth=args.sim_depth,
+                total_steps=args.sim_steps,
+                top_k=args.top_k,
+                repeat=args.repeat,
+                calibration=cal,
+                stream_dir=stream_dir,
+                log=log,
+            )
+        except (ValueError, RuntimeError) as e:
+            print(f"tpu-tlc: tune failed: {e}", file=sys.stderr)
+            return 2
+        print(tune_search.render_report(profile, rows))
+        print(f"profile: {tune_profiles.path_for(profile['sig'])}")
+        _ingest_tune_streams()
+        return 0
     try:
         profile, rows = tune_search.tune_device(
             model,
@@ -1164,22 +1287,102 @@ def _cmd_tune(args) -> int:
         return 2
     print(tune_search.render_report(profile, rows))
     print(f"profile: {tune_profiles.path_for(profile['sig'])}")
-    if args.ledger and stream_dir:
-        import glob as globmod
-
-        recs = []
-        for p in sorted(
-            globmod.glob(os.path.join(stream_dir, "tune_*.jsonl"))
-        ):
-            try:
-                recs.append(ledger.record_from_file(p))
-            except (OSError, ValueError, json.JSONDecodeError):
-                continue
-        added = ledger.append(args.ledger, recs)
-        print(
-            f"ingested {added} measured run(s) into {args.ledger}"
-        )
+    _ingest_tune_streams()
     return 0
+
+
+def _sim_model(args):
+    """Model + constants + invariants for the ``simulate`` subcommand:
+    a registry spec name (or .tla path of one), falling back to the
+    spec->kernel compiler for modules outside the registry."""
+    from pulsar_tlaplus_tpu.models import registry
+    from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+    spec = args.spec
+    module = (
+        os.path.splitext(os.path.basename(spec))[0]
+        if spec.endswith(".tla")
+        else spec
+    )
+    cfg_path = args.config
+    if cfg_path is None:
+        if spec.endswith(".tla"):
+            cfg_path = os.path.splitext(spec)[0] + ".cfg"
+        else:
+            cfg_path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "specs", f"{module}.cfg",
+            )
+    tlc_cfg = cfgmod.load(cfg_path)
+    invariants = tuple(args.invariant or tlc_cfg.invariants)
+    if module in registry.COMPILED:
+        model, constants = registry.COMPILED[module](tlc_cfg)
+        return model, constants, invariants, module
+    # outside the registry: the spec->kernel compiler path
+    from pulsar_tlaplus_tpu.frontend.codegen import CompiledSpec
+    from pulsar_tlaplus_tpu.frontend.interp import Spec
+    from pulsar_tlaplus_tpu.frontend.loader import bind_cfg
+    from pulsar_tlaplus_tpu.frontend.parser import parse_file
+
+    if not spec.endswith(".tla"):
+        raise ValueError(
+            f"spec {spec!r} is not in the compiled registry "
+            f"(known: {sorted(registry.COMPILED)}); pass a .tla path "
+            "to route through the spec->kernel compiler"
+        )
+    ast = parse_file(spec)
+    consts = bind_cfg(ast, tlc_cfg)
+    consts.pop("__string_interning__", None)
+    cs = CompiledSpec(Spec(ast, consts), invariants=invariants)
+    return cs, None, invariants, module
+
+
+def _cmd_simulate(args) -> int:
+    """Streaming walker-swarm simulation (sim/engine.py,
+    docs/simulation.md): TLC's ``-simulate`` as a budgeted workload —
+    thousands of vectorized random walks per dispatch, running until a
+    violation or the step/walk/time budget, resumable via
+    -checkpoint/-recover."""
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from pulsar_tlaplus_tpu.sim.engine import StreamingSimulator
+
+    try:
+        model, constants, invariants, module = _sim_model(args)
+    except (OSError, ValueError) as e:
+        sys.exit(f"tpu-tlc: {e}")
+    print(
+        f"tpu-tlc: simulating {module} ({args.walkers} walkers, depth "
+        f"{args.depth}; invariants: {list(invariants) or 'none'})"
+    )
+    try:
+        sim = StreamingSimulator(
+            model,
+            invariants=invariants,
+            n_walkers=args.walkers,
+            depth=args.depth,
+            segment_len=args.segment,
+            seed=args.seed,
+            max_steps=args.max_steps,
+            max_rounds=args.rounds,
+            time_budget_s=args.time_budget,
+            checkpoint_path=args.checkpoint,
+            telemetry=args.telemetry,
+            heartbeat_s=args.progress,
+            progress=True,
+            profile=_profile_arg(args),
+        )
+        sres = sim.run(resume=args.recover)
+    except FileNotFoundError:
+        sys.exit(
+            "tpu-tlc: -recover needs an existing -checkpoint file "
+            f"(got: {args.checkpoint})"
+        )
+    except (ValueError, RuntimeError) as e:
+        sys.exit(f"tpu-tlc: {e}")
+    return _report_simulation(sres, constants, args.checkpoint)
 
 
 def _cmd_cache(args) -> int:
@@ -1347,6 +1550,33 @@ def main(argv=None):
     pj.add_argument(
         "--time-budget", type=float, default=None, metavar="SEC",
         help="cumulative engine-wall budget across scheduling slices",
+    )
+    pj.add_argument(
+        "--mode", choices=["check", "simulate"], default="check",
+        help="workload: exhaustive BFS (default) or the streaming "
+        "walker swarm — simulation jobs time-slice at segment "
+        "boundaries (docs/simulation.md)",
+    )
+    pj.add_argument(
+        "--walkers", type=int, default=None,
+        help="with --mode simulate: walker swarm width",
+    )
+    pj.add_argument(
+        "--depth", type=int, default=None,
+        help="with --mode simulate: steps per behavior",
+    )
+    pj.add_argument(
+        "--segment", type=int, default=None,
+        help="with --mode simulate: steps per device dispatch",
+    )
+    pj.add_argument(
+        "--sim-seed", dest="sim_seed", type=int, default=None,
+        help="with --mode simulate: PRNG seed (deterministic stream)",
+    )
+    pj.add_argument(
+        "--sim-steps", dest="sim_steps", type=int, default=None,
+        help="with --mode simulate: total step budget across the "
+        "swarm (default: one depth-round)",
     )
     pj.add_argument(
         "--priority", type=int, default=0, metavar="N",
@@ -1536,6 +1766,21 @@ def main(argv=None):
         "default: cfg INVARIANTS — part of the profile key)",
     )
     ptn.add_argument(
+        "--mode", choices=["check", "simulate"], default="check",
+        help="tune the exhaustive device engine (default) or the "
+        "streaming simulation engine's SIM_KNOBS (n_walkers, "
+        "segment_len; docs/simulation.md)",
+    )
+    ptn.add_argument(
+        "--sim-depth", dest="sim_depth", type=int, default=64,
+        help="with --mode simulate: steps per behavior",
+    )
+    ptn.add_argument(
+        "--sim-steps", dest="sim_steps", type=int, default=None,
+        help="with --mode simulate: swarm-total step budget per "
+        "measured run (default 4 rounds of 1024 walkers)",
+    )
+    ptn.add_argument(
         "--maxstates", type=int, default=1 << 22,
         help="state budget per measured run (keep it short: the "
         "tuner needs relative wall, not exhaustion)",
@@ -1597,6 +1842,85 @@ def main(argv=None):
         "profile's later runs carry its sig)",
     )
     ptn.add_argument(
+        "-cpu", action="store_true", help="force the CPU backend"
+    )
+
+    psim = sub.add_parser(
+        "simulate",
+        help="streaming walker-swarm simulation (TLC -simulate, "
+        "reborn: thousands of vectorized random walks per dispatch "
+        "under step/walk/time budgets, resumable and deterministic "
+        "given -seed; docs/simulation.md)",
+    )
+    psim.add_argument(
+        "spec",
+        help="registry spec name (e.g. compaction) or a .tla path",
+    )
+    psim.add_argument(
+        "-config", default=None,
+        help=".cfg constant bindings (default: specs/<spec>.cfg)",
+    )
+    psim.add_argument(
+        "-invariant", action="append", default=None,
+        help="invariant to check (repeatable; default: cfg INVARIANTS)",
+    )
+    psim.add_argument(
+        "-walkers", type=int, default=None, metavar="N",
+        help="walker swarm width (default 1024, or the tuned "
+        "profile's n_walkers)",
+    )
+    psim.add_argument(
+        "-depth", type=int, default=64,
+        help="steps per behavior before walkers restart (TLC "
+        "-simulate depth; default 64)",
+    )
+    psim.add_argument(
+        "-segment", type=int, default=None, metavar="STEPS",
+        help="steps per device dispatch (clamped to a divisor of "
+        "-depth; default min(depth, 32) or the tuned profile)",
+    )
+    psim.add_argument(
+        "-seed", type=int, default=0,
+        help="PRNG seed — the whole walk stream is deterministic "
+        "given it (default 0)",
+    )
+    psim.add_argument(
+        "-max-steps", dest="max_steps", type=int, default=None,
+        help="stop after this many random steps across the swarm",
+    )
+    psim.add_argument(
+        "-rounds", type=int, default=None, metavar="N",
+        help="stop after N completed behavior rounds per walker",
+    )
+    psim.add_argument(
+        "-time-budget", dest="time_budget", type=float, default=None,
+        metavar="SEC", help="wall-clock budget",
+    )
+    psim.add_argument(
+        "-checkpoint", default=None,
+        help="checkpoint file (.npz): segment-boundary frames; "
+        "SIGTERM/SIGINT exit resumably; resume the IDENTICAL walk "
+        "stream with -recover",
+    )
+    psim.add_argument(
+        "-recover", action="store_true",
+        help="resume from -checkpoint",
+    )
+    psim.add_argument(
+        "-telemetry", metavar="FILE",
+        help="write the v11 run-event stream (run_header.mode="
+        "simulate, cumulative `sim` records) to this file",
+    )
+    psim.add_argument(
+        "-progress", type=float, default=None, metavar="SEC",
+        help="heartbeat line every SEC seconds (states, steps, "
+        "walks/s EWMA — zero extra device syncs)",
+    )
+    psim.add_argument(
+        "-no-profile", dest="no_profile", action="store_true",
+        help="skip tuned-profile resolution (SIM_KNOBS; docs/tuning.md)",
+    )
+    psim.add_argument(
         "-cpu", action="store_true", help="force the CPU backend"
     )
 
@@ -1762,6 +2086,20 @@ def main(argv=None):
     )
     pc.add_argument("-depth", type=int, default=64, help="simulation depth")
     pc.add_argument(
+        "-segment", type=int, default=None, metavar="STEPS",
+        help="with -simulate: steps per device dispatch (clamped to "
+        "a divisor of -depth)",
+    )
+    pc.add_argument(
+        "-sim-seed", dest="sim_seed", type=int, default=0,
+        help="with -simulate: PRNG seed (deterministic walk stream)",
+    )
+    pc.add_argument(
+        "-sim-steps", dest="sim_steps", type=int, default=None,
+        help="with -simulate: total step budget across the swarm "
+        "(default: one depth-round, the legacy one-shot semantics)",
+    )
+    pc.add_argument(
         "-metrics", help="write per-level JSONL metrics to this file"
     )
     pc.add_argument(
@@ -1864,6 +2202,7 @@ def main(argv=None):
     if args.cmd != "check":
         return {
             "serve": _cmd_serve,
+            "simulate": _cmd_simulate,
             "tune": _cmd_tune,
             "submit": _cmd_submit,
             "status": _cmd_status,

@@ -2633,6 +2633,9 @@ class DeviceChecker:
             # daemon scheduler, None on standalone runs — always
             # present so per-tenant attribution never needs a join
             tenant=getattr(self, "tenant", None),
+            # workload class (r18, schema v11): always "check" here —
+            # the streaming walker swarm (sim/) is its own engine
+            mode="check",
         )
         rm = self._resume_meta
         if resume and rm:

@@ -1073,6 +1073,8 @@ class LivenessChecker:
             hbm_budget=getattr(self._checker, "hbm_budget", None),
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
+            # v11: workload class (two-phase liveness check)
+            mode="liveness",
             wall_unix=round(time.time(), 3),
             goal=self.goal_name,
             fairness=self.fairness,

@@ -521,6 +521,8 @@ class ShardedChecker:
             hbm_budget=None,
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
+            # v11: workload class (exhaustive BFS)
+            mode="check",
             wall_unix=round(time.time(), 3),
             max_states=self.max_states,
             invariants=list(self.invariant_names),

@@ -1933,6 +1933,8 @@ class ShardedDeviceChecker:
             hbm_budget=None,
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
+            # v11: workload class (exhaustive BFS)
+            mode="check",
             wall_unix=round(time.time(), 3),
             max_states=self.SCAP,
             sub_batch=self.G,

@@ -1,42 +1,34 @@
-"""Simulation mode (SURVEY.md §2.2-E9): TLC's ``-simulate`` re-architected
-as a batch of vmapped random walkers with per-lane PRNG keys.
+"""Legacy one-shot simulation API — a thin shim over the streaming
+swarm subsystem (``pulsar_tlaplus_tpu/sim/``, round 18).
 
-Each walker starts from a uniformly drawn initial state and takes ``depth``
-random steps; at each step one enabled ``Next`` lane is chosen uniformly
-(stuttering lanes — e.g. compaction's Consumer/Terminating — keep the
-state, matching TLC's behavior-space semantics).  Invariants are evaluated
-on every visited state.  No dedup table is needed, so throughput scales
-with walker count.
+The round-2 :class:`Simulator` rolled a fixed batch of walkers to a
+fixed depth once and returned.  That exact contract — constructor
+signature, :class:`SimulationResult` fields, one behavior round of
+``n_walkers`` walkers at ``depth`` steps, earliest-violation replay —
+is preserved here as a one-round budget on the streaming engine
+(``max_rounds=1``), so existing callers and tests run unchanged while
+every new capability (budgets, telemetry, checkpoints, the daemon,
+the bench/ledger loop, the tuner) lives in ``sim/engine.py``.
 
-The whole rollout is one ``lax.scan`` under ``jit``; on violation the
-offending walker is *replayed* on device with the same PRNG key (the
-rollout is deterministic given the key), this time materializing every
-visited state, to reconstruct the behavior exactly — model-agnostic, no
-host re-evaluation of the spec needed."""
+Note the r18 PRNG derivation is functional in ``(seed, step,
+walker)`` (the resumability contract), so a given seed explores a
+different — equally deterministic — walk stream than the pre-r18
+carried-key rollout did.
+"""
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from pulsar_tlaplus_tpu.ref import pyeval
-
-
-@dataclass
-class SimulationResult:
-    n_walkers: int
-    depth: int
-    states_visited: int  # walkers x steps (not distinct)
-    violation: Optional[str] = None
-    trace: Optional[list] = None
-    trace_actions: Optional[List[str]] = None
+from pulsar_tlaplus_tpu.sim.engine import (  # noqa: F401 — re-export
+    SimulationResult,
+    StreamingSimulator,
+)
 
 
 class Simulator:
+    """One-round walker-batch simulation (the legacy API)."""
+
     def __init__(
         self,
         model,
@@ -45,135 +37,20 @@ class Simulator:
         depth: int = 64,
         seed: int = 0,
     ):
+        self._eng = StreamingSimulator(
+            model,
+            invariants=invariants,
+            n_walkers=n_walkers,
+            depth=depth,
+            seed=seed,
+            max_rounds=1,
+            profile=None,  # the one-shot API predates tuned profiles
+        )
         self.model = model
-        if invariants is None:
-            invariants = getattr(
-                model, "default_invariants", pyeval.DEFAULT_INVARIANTS
-            )
-        self.invariant_names = tuple(invariants)
-        self.B = n_walkers
-        self.T = depth
+        self.invariant_names = self._eng.invariant_names
+        self.B = self._eng.B
+        self.T = self._eng.T
         self.seed = seed
 
-    # -- one walker's pieces (shared by rollout and replay) ----------------
-
-    def _init_one(self, k):
-        m = self.model
-        sampler = getattr(m, "sample_initial", None)
-        if sampler is not None:
-            return sampler(k)
-        # default: uniform over the Init fanout by drawing the index — only
-        # valid when n_initial fits i32; bigger fanouts must provide
-        # ``sample_initial`` or sampling would silently stop being uniform.
-        if m.n_initial > 2**31 - 1:
-            raise ValueError(
-                f"n_initial = {m.n_initial} exceeds int32: the model must "
-                "provide sample_initial(key) for simulation mode"
-            )
-        idx = jax.random.randint(k, (), 0, m.n_initial, jnp.int32)
-        return m.gen_initial(idx)
-
-    def _step_one(self, state, k, inv_fns):
-        m = self.model
-        succ, valid = m.successors(state)
-        stutter = m.stutter_enabled(state)
-        # uniform over enabled lanes; one extra lane = stutter (stay)
-        weights = jnp.concatenate(
-            [valid.astype(jnp.float32), stutter.astype(jnp.float32)[None]]
-        )
-        total = jnp.sum(weights)
-        # no enabled lane at all -> stay put (the exhaustive checker is
-        # what reports deadlocks; simulation just stops progressing)
-        fallback = jnp.zeros((m.A + 1,)).at[m.A].set(1.0)
-        probs = jnp.where(total > 0, weights / jnp.maximum(total, 1.0), fallback)
-        lane = jax.random.choice(k, m.A + 1, p=probs)
-        is_stutter = lane >= m.A
-        lane_c = jnp.minimum(lane, m.A - 1)
-        nxt = jax.tree.map(
-            lambda cur, s: jnp.where(is_stutter, cur, s[lane_c]),
-            state,
-            succ,
-        )
-        ok = (
-            jnp.stack([f(nxt) for f in inv_fns])
-            if inv_fns
-            else jnp.ones((0,), bool)
-        )
-        return nxt, (jnp.where(is_stutter, -1, lane_c).astype(jnp.int32), ok)
-
-    def _rollout(self, key):
-        m = self.model
-        inv_fns = [m.invariants[n] for n in self.invariant_names]
-
-        def walker(k):
-            k0, krest = jax.random.split(k)
-            s0 = self._init_one(k0)
-            ok0 = (
-                jnp.stack([f(s0) for f in inv_fns])
-                if inv_fns
-                else jnp.ones((0,), bool)
-            )
-            ks = jax.random.split(krest, self.T)
-            _, (lanes, oks) = jax.lax.scan(
-                lambda s, kk: self._step_one(s, kk, inv_fns), s0, ks
-            )
-            return s0, ok0, lanes, oks
-
-        keys = jax.random.split(key, self.B)
-        return jax.vmap(walker)(keys)
-
-    def _replay(self, walker_key):
-        """Re-run one walker, materializing every visited state."""
-        k0, krest = jax.random.split(walker_key)
-        s0 = self._init_one(k0)
-        ks = jax.random.split(krest, self.T)
-
-        def step(s, kk):
-            nxt, (lane, _ok) = self._step_one(s, kk, [])
-            return nxt, (nxt, lane)
-
-        _, (states, lanes) = jax.lax.scan(step, s0, ks)
-        return s0, states, lanes
-
     def run(self) -> SimulationResult:
-        m = self.model
-        key = jax.random.PRNGKey(self.seed)
-        _s0, ok0, _lanes, oks = jax.jit(self._rollout)(key)
-        oks = np.asarray(oks)  # [B, T, n_inv]
-        ok0 = np.asarray(ok0)  # [B, n_inv]
-        res = SimulationResult(
-            n_walkers=self.B,
-            depth=self.T,
-            states_visited=self.B * (self.T + 1),
-        )
-        bad0 = np.argwhere(~ok0)
-        badt = np.argwhere(~oks)
-        first = None  # (walker, step index: 0 = initial state, inv)
-        if len(bad0):
-            b, i = bad0[0]
-            first = (int(b), 0, int(i))
-        if len(badt):
-            b, t, i = badt[np.lexsort((badt[:, 0], badt[:, 1]))][0]
-            if first is None or int(t) + 1 < first[1]:
-                first = (int(b), int(t) + 1, int(i))
-        if first is None:
-            return res
-        b, t_viol, inv_i = first
-        res.violation = self.invariant_names[inv_i]
-        # replay walker b on device with its key; collect the behavior
-        walker_key = jax.random.split(key, self.B)[b]
-        s0, states, lanes = jax.jit(self._replay)(walker_key)
-        lane_log = np.asarray(lanes)
-        names = getattr(m, "action_names", pyeval.ACTION_NAMES)
-        take = lambda tree, i: jax.tree.map(lambda x: np.asarray(x)[i], tree)
-        trace = [m.to_pystate(jax.tree.map(np.asarray, s0))]
-        actions: List[str] = []
-        for step in range(t_viol):
-            lane = int(lane_log[step])
-            if lane < 0:
-                continue  # stutter: state unchanged, not part of the trace
-            trace.append(m.to_pystate(take(states, step)))
-            actions.append(names[int(m.action_ids[lane])])
-        res.trace = trace
-        res.trace_actions = actions
-        return res
+        return self._eng.run()

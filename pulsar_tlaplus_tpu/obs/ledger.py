@@ -64,6 +64,12 @@ GATE_DIRECTIONS: Dict[str, str] = {
     # trajectories (timing-dependent — NOT in the deterministic set)
     "spill_bytes_per_state": "lower",
     "spill_overlap_ratio": "higher",
+    # swarm simulation (r18): walks/s gates real-chip throughput
+    # trajectories; steps/state is DETERMINISTIC for a fixed (seed,
+    # n_walkers, depth, budget) — a change means the walk stream
+    # itself changed, which is the regression the tier-1 sim gate pins
+    "walks_per_sec": "higher",
+    "steps_per_state": "lower",
 }
 # the machine-independent subset — the tier-1 gate's default
 DETERMINISTIC_GATE_KEYS = (
@@ -75,6 +81,10 @@ DETERMINISTIC_GATE_KEYS = (
 # (tests/test_store.py) when gating a tiered record against the
 # committed tiered baseline
 SPILL_GATE_KEYS = ("spill_bytes_per_state",)
+# the simulation-path deterministic subset (fixed seed + budget =>
+# the identical walk stream): the tier-1 sim gate's explicit key set
+# (tests/test_sim.py) against the committed sim baseline
+SIM_GATE_KEYS = ("steps_per_state",)
 
 
 def _digest(values: dict) -> str:
@@ -86,7 +96,8 @@ def _engine_kind(engine: Optional[str]) -> str:
     if not engine:
         return "?"
     for known in (
-        "device_bfs", "sharded_device", "liveness", "sharded", "bfs",
+        "device_bfs", "sharded_device", "liveness", "sharded", "sim",
+        "bfs",
     ):
         if known in engine:
             return known

@@ -213,10 +213,43 @@ def _engine_families(
             "Hot-filter survivors resolved against the cold tiers",
         ).add(stats.get("spill_misses_resolved")),
     ]
+    # swarm-simulation families (r18): the streaming walker engine's
+    # cumulative counters + the advisory duplicate estimate — present
+    # only when the focal run is a simulation (absent beats zero)
+    sim_fams = [
+        Family(
+            "ptt_sim_steps_total", "counter",
+            "Random steps taken across the walker swarm",
+        ).add(stats.get("sim_steps")),
+        Family(
+            "ptt_sim_states_total", "counter",
+            "States visited by the swarm (not distinct)",
+        ).add(stats.get("sim_states")),
+        Family(
+            "ptt_sim_walks_total", "counter",
+            "Completed behaviors (walker-rounds finished)",
+        ).add(stats.get("sim_walks")),
+        Family(
+            "ptt_sim_violations_total", "counter",
+            "Walker-steps that hit an invariant violation",
+        ).add(stats.get("sim_violations")),
+        Family(
+            "ptt_sim_walkers", "gauge",
+            "Walker swarm width (vectorized walks per dispatch)",
+        ).add(stats.get("sim_walkers")),
+        Family(
+            "ptt_sim_walks_per_sec", "gauge",
+            "Completed-behavior throughput",
+        ).add(stats.get("walks_per_sec")),
+        Family(
+            "ptt_sim_dup_ratio_est", "gauge",
+            "Sampled-duplicate estimate (advisory coverage signal)",
+        ).add(stats.get("sim_dup_ratio_est")),
+    ]
     return [
         f_distinct, f_rate, f_level, f_frontier, f_occ, f_probe,
         f_lanes, f_flushes, f_hbm, f_frames, f_stall, f_fetches,
-    ] + work_fams + spill_fams
+    ] + work_fams + spill_fams + sim_fams
 
 
 def _admission_families(
@@ -392,7 +425,7 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     stall = 0.0
     hbm = 0
     work: Dict[str, int] = {}
-    spill_last: Dict[str, object] = {}
+    last_cum: Dict[str, object] = {}  # newest cumulative-event values (spill/sim)
     adm_admitted: Dict[str, float] = {}
     adm_rejected: Dict[Tuple[str, str], float] = {}
     adm_deduped: Dict[str, float] = {}
@@ -408,6 +441,23 @@ def stream_metrics(events: List[dict]) -> List[Family]:
             elif action in ("reject", "shed"):
                 key = (tenant, str(e.get("reason", "?")))
                 adm_rejected[key] = adm_rejected.get(key, 0) + 1
+        if ev == "sim":
+            # cumulative v11 counters: the NEWEST record is the total
+            # — the event fallback so a live/crashed simulation's
+            # stream still exports ptt_sim_* before any result record
+            # NOTE: sim states are NOT distinct (the swarm never
+            # dedups) — they must never feed ptt_distinct_states /
+            # ptt_states_per_sec; the ptt_sim_* families carry them
+            for src, dst in (
+                ("steps", "sim_steps"), ("states", "sim_states"),
+                ("walks", "sim_walks"),
+                ("violations", "sim_violations"),
+                ("walkers", "sim_walkers"),
+                ("dup_ratio_est", "sim_dup_ratio_est"),
+                ("steps_per_sec", "steps_per_sec"),
+            ):
+                if isinstance(e.get(src), (int, float)):
+                    last_cum[dst] = e[src]
         if ev == "spill":
             # cumulative v9 counters: the NEWEST record is the total —
             # the event fallback so a live/crashed tiered run's stream
@@ -418,7 +468,7 @@ def stream_metrics(events: List[dict]) -> List[Family]:
                 "bytes_comp", "transfer_s", "misses_resolved",
             ):
                 if isinstance(e.get(k), (int, float)):
-                    spill_last[f"spill_{k}"] = e[k]
+                    last_cum[f"spill_{k}"] = e[k]
         if ev == "fuse":
             # per-dispatch work deltas (v7): the event-sum fallback so
             # a crashed run's stream still exports ptt_work_* families
@@ -468,7 +518,7 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     stats.setdefault("hbm_recovered", hbm or None)
     for k, v in work.items():
         stats.setdefault(k, v or None)
-    for k, v in spill_last.items():
+    for k, v in last_cum.items():
         stats.setdefault(k, v)
 
     fams = _engine_families(stats, snap)

@@ -178,8 +178,12 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
     out: Dict[str, object] = {
         k: v
         for k, v in stats.items()
-        if k.startswith(("fpset_", "ckpt_", "work_", "spill_"))
-        or k == "hbm_budget"
+        if k.startswith(("fpset_", "ckpt_", "work_", "spill_", "sim_"))
+        or k in (
+            "hbm_budget",
+            # swarm-simulation throughput keys (r18, bench_schema 9)
+            "walks_per_sec", "steps_per_sec", "steps_per_state",
+        )
     }
     for k in (
         "distinct_states", "diameter", "wall_s", "states_per_sec",
@@ -243,9 +247,24 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
             int(e.get("dispatches", 0)) for e in fuses
         )
         out["fuse_levels"] = sum(int(e.get("levels", 0)) for e in fuses)
+    sims = [e for e in events if e.get("event") == "sim"]
+    if sims and "sim_steps" not in out:
+        # cumulative contract: the newest record is the total — the
+        # fallback for a simulation stream whose run died pre-result
+        last = sims[-1]
+        for src, dst in (
+            ("steps", "sim_steps"), ("states", "sim_states"),
+            ("walks", "sim_walks"), ("violations", "sim_violations"),
+            ("walkers", "sim_walkers"),
+            ("dup_ratio_est", "sim_dup_ratio_est"),
+        ):
+            if last.get(src) is not None:
+                out[dst] = last[src]
     hd = header(events)
     if hd is not None:
         out["engine"] = hd.get("engine")
+        if hd.get("mode"):
+            out["mode"] = hd.get("mode")
         out["visited_impl"] = hd.get("visited_impl")
         if "compact_impl" not in out and hd.get("compact_impl"):
             out["compact_impl"] = hd.get("compact_impl")

@@ -104,10 +104,22 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # cancelled by the deadline sweep, ``stop_reason="deadline"``).  The
 # ``spill`` record may carry ``degraded: true`` when the spill tier
 # lost durability to ENOSPC (stop_reason="spill_enospc").
+# v11 (round 18, the swarm simulation subsystem): run headers carry
+# ``mode`` — the workload class (``check`` for exhaustive BFS,
+# ``liveness`` for the two-phase liveness engine, ``simulate`` for the
+# streaming walker swarm; REQUIRED at v11 like profile_sig /
+# hbm_budget / tenant so workload trajectories always split) — and the
+# simulation engine (sim/engine.py) emits one ``sim`` record per
+# segment dispatch: CUMULATIVE steps / walkers / violations plus the
+# states/walks totals, stutter and enabled-lane counters, and the
+# sampled-duplicate estimator — cumulative so the validator can
+# cross-check monotonicity exactly like ``spill`` (a sim record whose
+# counters go backwards is a torn writer or a silently re-based walk
+# stream; docs/simulation.md).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -175,6 +187,13 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     # admission decisions, TCP auth handshakes, deadline cancels —
     # gated so every committed v9-and-older stream stays clean.
     ("run_header", "tenant"): 10,
+    # v11 (round 18): the workload class on every run header and the
+    # streaming simulation engine's cumulative ``sim`` record — gated
+    # so every committed v10-and-older stream stays clean.
+    ("run_header", "mode"): 11,
+    ("sim", "steps"): 11,
+    ("sim", "walkers"): 11,
+    ("sim", "violations"): 11,
     ("admission", "action"): 10,
     ("admission", "tenant"): 10,
     ("auth", "action"): 10,
@@ -193,7 +212,7 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # hbm_budget — the tiered-store byte budget, null when untiered)
     "run_header": (
         "engine", "visited_impl", "config_sig", "profile_sig",
-        "hbm_budget", "tenant",
+        "hbm_budget", "tenant", "mode",
     ),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress
@@ -266,6 +285,14 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "job_cancel": ("job_id",),
     # daemon lifecycle: start (socket, pid, warmed specs) / stop
     "serve": ("action",),
+    # swarm simulation (r18, sim/engine.py): one record per segment
+    # dispatch with CUMULATIVE per-run counters — random steps taken
+    # across the swarm, the (constant) walker count, walker-steps
+    # with invariant failures, states visited, completed walks, and
+    # the sampled-duplicate estimator.  Cumulative so the validator's
+    # monotone cross-check catches torn/re-based writers (the same
+    # contract as ``spill``).
+    "sim": ("steps", "walkers", "violations"),
     # open-network hardening (r17, service/): one admission record
     # per submit decision — action in {admit, reject, shed, dedup},
     # reason in {queue_full, tenant_queued, tenant_running,
@@ -429,6 +456,12 @@ class Heartbeat:
         # lurches at every fetch; the exponentially weighted average is
         # what the line and the ETA report.  None until the first beat.
         self.ewma_sps: Optional[float] = None
+        # walks/s EWMA (r18): simulation engines put a cumulative
+        # ``walks`` count in the snapshot — completed behaviors land
+        # B-at-a-time per round, the chunkiest counter there is, so
+        # the reported walks/s is always the smoothed estimate
+        self.ewma_wps: Optional[float] = None
+        self._prev_walks: Optional[Tuple[float, int]] = None
 
     # EWMA weight of the newest beat-over-beat rate sample: ~0.3 keeps
     # the line responsive (half-life ~2 beats) while absorbing the
@@ -464,6 +497,22 @@ class Heartbeat:
                 self.EWMA_ALPHA * recent_sps
                 + (1.0 - self.EWMA_ALPHA) * self.ewma_sps
             )
+        # simulation engines (r18): cumulative completed-walk count in
+        # the snapshot -> a smoothed walks/s beside the state rate
+        walks = self.snap.get("walks")
+        if walks is not None:
+            walks = int(walks)
+            if self._prev_walks is None:
+                self._prev_walks = (t_start, 0)
+            dwt = max(now - self._prev_walks[0], 1e-9)
+            recent_wps = max(walks - self._prev_walks[1], 0) / dwt
+            self.ewma_wps = (
+                recent_wps
+                if self.ewma_wps is None
+                else self.EWMA_ALPHA * recent_wps
+                + (1.0 - self.EWMA_ALPHA) * self.ewma_wps
+            )
+            self._prev_walks = (now, walks)
         # the engine tags its snapshot ``partial`` when the last level
         # record was an intra-level anchor — mark the line so a reader
         # knows the level/frontier figures are mid-level
@@ -476,9 +525,20 @@ class Heartbeat:
             + ("~" if partial else "")
             + f") at {elapsed:.0f}s: "
             + (f"{int(gen):,} states generated, " if gen is not None else "")
-            + f"{nv:,} distinct states"
+            # a simulation snapshot (walks present) counts VISITED
+            # states — the swarm never dedups, so "distinct" would lie
+            + (
+                f"{nv:,} states visited"
+                if walks is not None
+                else f"{nv:,} distinct states"
+            )
             + (f", frontier {int(frontier):,}" if frontier is not None else "")
             + f", {self.ewma_sps:,.0f} st/s (avg {avg_sps:,.0f})"
+            + (
+                f", {walks:,} walks ({self.ewma_wps:,.1f} walks/s)"
+                if walks is not None and self.ewma_wps is not None
+                else ""
+            )
             + (f", fpset occupancy {occ:.1%}" if occ is not None else "")
             + (
                 f", ~{eta_s:.0f}s to the state cap"
@@ -494,6 +554,14 @@ class Heartbeat:
             states_per_sec_ewma=round(self.ewma_sps, 1),
             avg_states_per_sec=round(avg_sps, 1),
             **({"partial": True} if partial else {}),
+            **(
+                {
+                    "walks": walks,
+                    "walks_per_sec_ewma": round(self.ewma_wps, 2),
+                }
+                if walks is not None and self.ewma_wps is not None
+                else {}
+            ),
             **({"generated": int(gen)} if gen is not None else {}),
             **({"level": level} if level is not None else {}),
             **(

@@ -190,6 +190,9 @@ def _engine_track_events(
     spill_tid = tid * 100
     prev_spill_s = 0.0
     n_spill = 0
+    # (t, cumulative steps, cumulative walks) of the previous sim
+    # record — the walker-throughput counters are per-segment deltas
+    prev_sim: Optional[tuple] = None
     for e in events:
         ev = e.get("event")
         t = e.get("t")
@@ -305,6 +308,33 @@ def _engine_track_events(
             if vals:
                 out.append(
                     _counter(pid, tid, "fused work units", t + off, vals)
+                )
+        elif ev == "sim":
+            # walker-throughput counter track (r18): each cumulative
+            # ``sim`` record renders the segment's step/walk deltas as
+            # stacked counters plus the engine's own recent steps/s —
+            # the simulation analog of the "states/s" track
+            dt = max(t - (prev_sim[0] if prev_sim else 0.0), 1e-9)
+            steps = float(e.get("steps", 0) or 0)
+            walks = float(e.get("walks", 0) or 0)
+            d_steps = steps - (prev_sim[1] if prev_sim else 0.0)
+            d_walks = walks - (prev_sim[2] if prev_sim else 0.0)
+            prev_sim = (t, steps, walks)
+            out.append(
+                _counter(
+                    pid, tid, "walker throughput", t + off,
+                    {
+                        "steps_per_sec": round(max(d_steps, 0) / dt, 1),
+                        "walks_per_sec": round(max(d_walks, 0) / dt, 2),
+                    },
+                )
+            )
+            if e.get("dup_ratio_est") is not None:
+                out.append(
+                    _counter(
+                        pid, tid, "sim duplicate est", t + off,
+                        {"dup_ratio": e["dup_ratio_est"]},
+                    )
                 )
         elif ev == "spill":
             dur = max(

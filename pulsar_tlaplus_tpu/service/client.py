@@ -182,10 +182,15 @@ class ServiceClient:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         submit_id: Optional[str] = None,
+        mode: str = "check",
+        sim: Optional[dict] = None,
     ) -> str:
         """Queue a job.  ``submit_id`` (auto-generated when omitted)
         makes the submit idempotent: the retry a dropped reply forces
-        returns the SAME job_id instead of enqueueing twice."""
+        returns the SAME job_id instead of enqueueing twice.
+        ``mode="simulate"`` queues a streaming walker-swarm job;
+        ``sim`` carries its knobs (n_walkers, depth, segment_len,
+        seed, max_steps — docs/simulation.md)."""
         r = self._request(
             "submit",
             spec=spec,
@@ -196,6 +201,8 @@ class ServiceClient:
             priority=priority,
             deadline_s=deadline_s,
             submit_id=submit_id or uuid.uuid4().hex,
+            mode=mode,
+            **({"sim": sim} if sim else {}),
         )
         return r["job_id"]
 

@@ -67,6 +67,13 @@ class Job:
     priority: int = 0
     deadline_unix: Optional[float] = None
     submit_id: Optional[str] = None
+    # workload mode (r18): "check" = exhaustive BFS (the default),
+    # "simulate" = the streaming walker swarm (sim/engine.py) — a
+    # simulation job time-slices at SEGMENT boundaries through the
+    # same suspend/resume primitive, and ``sim`` carries its knobs
+    # (n_walkers, depth, segment_len, seed, max_steps)
+    mode: str = "check"
+    sim: Optional[dict] = None
     state: str = QUEUED
     submitted_unix: float = field(default_factory=lambda: time.time())
     started_unix: Optional[float] = None
@@ -125,6 +132,7 @@ class Job:
             "cfg_path": self.cfg_path,
             "state": self.state,
             "tenant": self.tenant,
+            "mode": self.mode,
             "priority": self.priority,
             "submitted_unix": round(self.submitted_unix, 3),
             "slices": self.slices,
@@ -140,6 +148,8 @@ class Job:
             for k in (
                 "distinct_states", "diameter", "violation",
                 "truncated", "stop_reason", "status",
+                # simulation headline counters (r18)
+                "steps", "states_visited", "walks",
             ):
                 if k in self.result:
                     s[k] = self.result[k]

@@ -5,7 +5,9 @@ is a single JSON object on one ``\\n``-terminated line (the same
 crash-durable line discipline as the telemetry streams):
 
 - request: ``{"op": "submit", ...}`` — over TCP, additionally an
-  ``"auth": "<bearer token>"`` field (service/auth.py)
+  ``"auth": "<bearer token>"`` field (service/auth.py); ``"mode":
+  "simulate"`` + a ``"sim"`` knob object queue a streaming
+  walker-swarm job instead of exhaustive BFS (docs/simulation.md)
 - response: ``{"ok": true, ...}`` or ``{"ok": false, "error": "...",
   "code": "..."}`` — ``code`` is the TYPED rejection class the client
   maps to a distinct exit code: ``auth`` (bad/missing token),

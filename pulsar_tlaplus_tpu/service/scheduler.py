@@ -154,6 +154,9 @@ class CheckerPool:
     def __init__(self, config: ServiceConfig):
         self.config = config
         self._checkers: Dict[tuple, object] = {}
+        # streaming simulators (r18): keyed like checkers but by the
+        # sim knob tuple — compile reuse across a sim job's slices
+        self._sims: Dict[tuple, object] = {}
         self._lock = threading.Lock()
 
     # ---------------------------------------------------------- keys
@@ -259,6 +262,42 @@ class CheckerPool:
                 )
                 self._checkers[key] = ck
             return key, ck
+
+    def get_sim(
+        self, spec: str, tlc_cfg, invariants: Tuple[str, ...],
+        sim: dict,
+    ):
+        """A cached StreamingSimulator for a simulation job's exact
+        knob set (per-slice state — checkpoint path, telemetry,
+        budgets, the suspend hook — is (re)assigned per scheduling
+        slice, like the pooled checkers)."""
+        from pulsar_tlaplus_tpu.sim.engine import StreamingSimulator
+
+        key = (
+            "sim", spec, self._constants_sig(tlc_cfg),
+            tuple(invariants),
+            tuple(sorted((k, v) for k, v in sim.items())),
+        )
+        with self._lock:
+            eng = self._sims.get(key)
+            if eng is None:
+                model = self.build_model(spec, tlc_cfg)
+                eng = StreamingSimulator(
+                    model,
+                    invariants=invariants,
+                    n_walkers=sim.get("n_walkers"),
+                    depth=int(sim.get("depth") or 64),
+                    segment_len=sim.get("segment_len"),
+                    seed=int(sim.get("seed") or 0),
+                    max_steps=sim.get("max_steps"),
+                    profile=(
+                        "auto"
+                        if self.config.profiles != "none"
+                        else None
+                    ),
+                )
+                self._sims[key] = eng
+            return key, eng
 
     def warm(
         self, spec: str, cfg_path: Optional[str] = None,
@@ -583,6 +622,8 @@ class Scheduler:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         submit_id: Optional[str] = None,
+        mode: str = "check",
+        sim: Optional[dict] = None,
     ) -> Job:
         """Validate eagerly (bad specs/cfgs/invariants fail the submit,
         not the queue), deduplicate on the client's ``submit_id``
@@ -603,6 +644,35 @@ class Scheduler:
             raise ValueError(
                 f"deadline_s must be > 0: {deadline_s}"
             )
+        if mode not in ("check", "simulate"):
+            raise ValueError(
+                f"unknown job mode {mode!r} (want check|simulate)"
+            )
+        sim_norm: Optional[dict] = None
+        if mode == "simulate":
+            # normalize + eagerly validate the sim knobs (bad submits
+            # fail the submit, not the queue) — only known keys, all
+            # positive ints, so the pool's cache key is stable
+            sim = dict(sim or {})
+            sim_norm = {}
+            for k in (
+                "n_walkers", "depth", "segment_len", "seed",
+                "max_steps",
+            ):
+                v = sim.pop(k, None)
+                if v is None:
+                    continue
+                if not isinstance(v, int) or isinstance(v, bool) or (
+                    v < 0 or (v < 1 and k != "seed")
+                ):
+                    raise ValueError(
+                        f"sim.{k} must be a positive integer: {v!r}"
+                    )
+                sim_norm[k] = v
+            if sim:
+                raise ValueError(
+                    f"unknown sim knob(s): {sorted(sim)}"
+                )
         jid = jobmod.new_job_id()
         now = time.time()
         with self.cv:
@@ -649,6 +719,8 @@ class Scheduler:
                     else None
                 ),
                 submit_id=str(submit_id) if submit_id else None,
+                mode=mode,
+                sim=sim_norm,
             )
             self.admission.count_admit(tenant)
             self.jobs[jid] = job
@@ -674,7 +746,7 @@ class Scheduler:
         # fixes the run_id's offset on the shared wall timeline)
         self.tel.emit(
             "job_submit", job_id=jid, spec=spec, tenant=tenant,
-            priority=int(priority),
+            priority=int(priority), mode=mode,
             wall_unix=round(now, 3),
         )
         self.tel.emit(
@@ -955,6 +1027,8 @@ class Scheduler:
     def _run_slice(self, job: Job) -> None:
         from pulsar_tlaplus_tpu.utils import cfg as cfgmod
 
+        if job.mode == "simulate":
+            return self._run_sim_slice(job)
         job.slices += 1
         # resume iff a frame reached disk — even on slice 1: a crashed
         # daemon's mid-first-slice frame (recover() marked the job
@@ -1105,6 +1179,205 @@ class Scheduler:
             self.persist()
             return
         self._complete(job, r)
+
+    def _run_sim_slice(self, job: Job) -> None:
+        """One scheduling slice of a SIMULATION job (r18): the walker
+        swarm runs until the slice budget expires and another job
+        waits, suspending at a SEGMENT boundary through the same
+        cooperative hook as BFS jobs — the frame anchors the PRNG
+        position, so the resumed slice continues the identical walk
+        stream (solo parity pinned in tests/test_sim.py)."""
+        from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+        job.slices += 1
+        resume = os.path.exists(job.frame_path)
+        try:
+            tlc_cfg = cfgmod.load(job.cfg_path)
+            invs = (
+                tuple(job.invariants)
+                if job.invariants is not None
+                else self.pool.resolve_invariants(
+                    job.spec, tlc_cfg, None
+                )
+            )
+            _key, eng = self.pool.get_sim(
+                job.spec, tlc_cfg, invs, job.sim or {}
+            )
+        except Exception as e:  # noqa: BLE001 — a bad job must not
+            #                      take the scheduler thread down
+            self._fail(job, e)
+            return
+        remaining = None
+        if job.time_budget_s is not None:
+            remaining = job.time_budget_s - job.wall_s
+            if remaining <= 0:
+                self._complete_sim(job, None, budget_exhausted=True)
+                return
+        if not resume:
+            self.tel.emit(
+                "job_start",
+                job_id=job.job_id, spec=job.spec, slice=job.slices,
+            )
+        self._log(
+            f"job {job.job_id}: sim slice {job.slices} "
+            f"({'resume' if resume else 'start'})"
+        )
+        eng.checkpoint_path = job.frame_path
+        eng.time_budget_s = remaining
+        eng.tenant = job.tenant
+        eng._telemetry_arg = job.events_path
+        prev_wall = float(job.wall_s)
+        hook = self._mk_hook(
+            job, time.monotonic() + self.config.slice_s,
+            resume=resume, ck=eng,
+        )
+        eng.suspend_hook = hook
+        self._active_ck = eng
+        try:
+            r = eng.run(resume=resume)
+        except Exception as e:  # noqa: BLE001
+            self._fail(job, e)
+            return
+        finally:
+            eng.suspend_hook = None
+            self._active_ck = None
+            self.last_engine = {
+                "job_id": job.job_id,
+                "spec": job.spec,
+                "stats": dict(getattr(eng, "last_stats", {}) or {}),
+                "snap": dict(getattr(eng, "_snap", {}) or {}),
+            }
+        if eng._run_id:
+            job.run_ids.append(eng._run_id)
+        if resume and not hook.resume_emitted:
+            self.tel.emit(
+                "job_resume",
+                job_id=job.job_id, spec=job.spec, slice=job.slices,
+                restore_s=0.0,
+            )
+        job.wall_s = float(r.wall_s)
+        if r.stop_reason == "suspended":
+            job.suspends += 1
+            job.progress = {
+                "steps": int(r.steps),
+                "states_visited": int(r.states_visited),
+                "walks": int(r.walks),
+            }
+            with self.cv:
+                job.state = jobmod.SUSPENDED
+                self._running_id = None
+                self.fifo.append(job.job_id)
+                self.cv.notify_all()
+            self.persist()
+            suspend_extra = {
+                "slice_wall_s": round(
+                    max(float(r.wall_s) - prev_wall, 0.0), 3
+                ),
+            }
+            if eng._run_id:
+                suspend_extra["engine_run_id"] = eng._run_id
+            self.tel.emit(
+                "job_suspend", job_id=job.job_id, slice=job.slices,
+                **suspend_extra,
+            )
+            self._log(
+                f"job {job.job_id}: sim suspended at a segment "
+                f"boundary ({r.steps} steps so far)"
+            )
+            return
+        if r.stop_reason == "cancelled":
+            if not job.cancel_requested and (
+                job.deadline_unix is not None
+                and time.time() >= job.deadline_unix
+            ):
+                self._expire(job)
+                return
+            with self.cv:
+                self._finish(job, jobmod.CANCELLED)
+            self.persist()
+            return
+        self._complete_sim(job, r)
+
+    @staticmethod
+    def sim_result_record(job: Job, r) -> dict:
+        """The simulation result payload (`mode: "simulate"`): walk-
+        stream counters + throughput instead of the BFS state/diameter
+        story; status mirrors `check` semantics (a violation is a
+        verdict, an exhausted budget is a clean non-exhaustive end)."""
+        if r.violation:
+            status = "violation"
+        elif r.truncated:
+            status = "truncated"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "mode": "simulate",
+            "violation": r.violation,
+            "verified": r.verified,
+            "steps": int(r.steps),
+            "states_visited": int(r.states_visited),
+            "walks": int(r.walks),
+            "segments": int(r.segments),
+            "n_walkers": int(r.n_walkers),
+            "depth": int(r.depth),
+            "dup_ratio_est": r.dup_ratio_est,
+            "truncated": bool(r.truncated),
+            "stop_reason": r.stop_reason,
+            "trace": (
+                [repr(s) for s in r.trace]
+                if r.trace is not None
+                else None
+            ),
+            "trace_actions": (
+                list(r.trace_actions)
+                if r.trace_actions is not None
+                else None
+            ),
+            "wall_s": round(float(r.wall_s), 3),
+            "steps_per_sec": float(r.steps_per_sec),
+            "walks_per_sec": float(r.walks_per_sec),
+            "slices": job.slices,
+            "suspends": job.suspends,
+            "run_ids": list(job.run_ids),
+        }
+
+    def _complete_sim(
+        self, job: Job, r, budget_exhausted: bool = False
+    ) -> None:
+        if budget_exhausted:
+            # a time-budget end is a CLEAN (non-exhaustive) simulation
+            # result — the same status the engine reports when the
+            # budget expires mid-slice (stop_reason="time_budget",
+            # truncated=False), so slice timing never changes a sim
+            # job's status
+            job.result = {
+                "status": "ok",
+                "mode": "simulate",
+                "truncated": False,
+                "stop_reason": "time_budget",
+                "violation": None,
+                **(job.progress or {}),
+                "wall_s": round(float(job.wall_s), 3),
+                "slices": job.slices,
+                "suspends": job.suspends,
+                "run_ids": list(job.run_ids),
+            }
+        else:
+            job.result = self.sim_result_record(job, r)
+        err = _write_json_atomic(job.result_path, job.result)
+        if err is not None:
+            self._log(
+                f"job {job.job_id}: result.json write FAILED "
+                f"({err!r:.120}); table record stands"
+            )
+        with self.cv:
+            self._finish(job, jobmod.DONE)
+        self.persist()
+        self._log(
+            f"job {job.job_id}: done ({job.result.get('status')}, "
+            f"{job.result.get('steps')} sim steps)"
+        )
 
     # ----------------------------------------------------- completion
 

@@ -459,12 +459,18 @@ class ServiceDaemon:
         )
 
     def _op_submit(self, req, w) -> None:
+        mode = req.get("mode") or "check"
+        sim = req.get("sim")
+        if sim is not None and not isinstance(sim, dict):
+            raise ValueError("sim must be an object of knobs")
         job = self.sched.submit(
             spec=req["spec"],
             cfg_path=req["cfg"],
             invariants=req.get("invariants"),
             max_states=req.get("max_states"),
             time_budget_s=req.get("time_budget_s"),
+            mode=mode,
+            sim=sim,
             tenant=req["_tenant"],
             priority=max(
                 protocol.PRIORITY_MIN,

@@ -261,3 +261,72 @@ def rank(
     priced = [(c, predict_candidate(c, ref, cal)) for c in cands]
     priced.sort(key=lambda cp: cp[1]["est_s"])
     return priced
+
+
+# ------------------------------------------------------- simulation
+
+
+def predict_sim_candidate(
+    cand: Dict,
+    ref: Dict,
+    cal: Optional[dict] = None,
+) -> Dict[str, object]:
+    """Predicted wall of one simulation candidate for a FIXED step
+    budget (``ref["total_steps"]``), priced with the r14 calibration:
+
+    - per-step compute: every walker-step evaluates all ``A``
+      successor lanes of one state through the same vmapped model
+      kernels the expand stage runs, priced at ``expand_row_ns``
+      per lane-row, plus ``n_inv`` invariant evaluations priced at
+      ``probe_lane_ns`` (both per-unit approximations shared with
+      the explorer's model — stated tolerance applies);
+    - per-dispatch overhead: one dispatch + one stats fetch per
+      segment, priced at the calibration's measured ``rtt_s`` (or
+      the per-backend default) — the term ``segment_len`` amortizes
+      and the whole reason it is worth searching on the tunnel;
+    - swarm-width efficiency: widths below the reference's measured
+      occupancy knee pay the same dispatch for fewer steps — modeled
+      simply as the dispatch count scaling with ``total_steps /
+      (n_walkers * segment_len)``.
+
+    ``ref``: {"backend", "A", "n_inv", "depth", "total_steps",
+    "n_walkers", "segment_len"} (defaults for unset knobs)."""
+    backend = ref.get("backend", "cpu")
+    if cal is None:
+        cal = attribution.default_calibration(backend)
+    units = cal.get("units", {})
+    b = int(cand.get("n_walkers") or ref.get("n_walkers") or 1024)
+    depth = int(ref.get("depth") or 64)
+    seg = int(cand.get("segment_len") or ref.get("segment_len") or 32)
+    seg = max(1, min(seg, depth))
+    while depth % seg:  # the engine's divisor clamp
+        seg -= 1
+    total = int(ref.get("total_steps") or b * depth)
+    a = float(ref.get("A") or 1)
+    n_inv = float(ref.get("n_inv") or 0)
+    u_row = float(units.get("expand_row_ns") or 0.0)
+    u_lane = float(units.get("probe_lane_ns") or 0.0)
+    # steps are swarm-total, so per-step compute is width-invariant;
+    # what the width changes is the dispatch COUNT for the budget
+    est = total * (a * u_row + n_inv * u_lane) * 1e-9
+    per_disp = float(
+        cal.get("rtt_s")
+        or DEFAULT_DISPATCH_S.get(backend, DEFAULT_DISPATCH_S["tpu"])
+    )
+    segments = max(-(-total // (b * seg)), 1)
+    overhead = segments * per_disp
+    return {
+        "est_s": round(est + overhead, 6),
+        "est_work": {"steps": total},
+        "dispatches": int(segments),
+        "overhead_s": round(overhead, 6),
+    }
+
+
+def rank_sim(
+    cands: List[Dict], ref: Dict, cal: Optional[dict] = None
+) -> List[Tuple[Dict, Dict]]:
+    """Simulation candidates priced and sorted cheapest-first."""
+    priced = [(c, predict_sim_candidate(c, ref, cal)) for c in cands]
+    priced.sort(key=lambda cp: cp[1]["est_s"])
+    return priced

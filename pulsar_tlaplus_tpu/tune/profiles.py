@@ -56,6 +56,8 @@ _SCALAR = (int, float, bool, str, type(None))
 _POSITIVE_INT_KNOBS = (
     "sub_batch", "flush_factor", "group", "fuse_group",
     "fpset_dense_rounds", "sweep_group", "miss_batch",
+    # swarm-simulation knobs (r18, engine "sim")
+    "n_walkers", "segment_len",
 )
 _COMPACT_IMPLS = ("logshift", "sort")
 

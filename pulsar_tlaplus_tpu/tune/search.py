@@ -227,6 +227,140 @@ def tune_device(
     return profile, rows
 
 
+def tune_sim(
+    model,
+    *,
+    invariants: Tuple[str, ...],
+    spec_label: str = "?",
+    depth: int = 64,
+    total_steps: Optional[int] = None,
+    top_k: int = 3,
+    repeat: int = 2,
+    calibration: Optional[dict] = None,
+    stream_dir: Optional[str] = None,
+    log=None,
+) -> Tuple[dict, List[Dict]]:
+    """The simulation-engine search (``cli.py tune --mode simulate``):
+    predict the SIM_KNOBS space (n_walkers, segment_len) with the
+    calibrated model at a fixed step budget, measure the top-K with
+    interleaved min-of-N runs, persist the winner as an
+    ``engine="sim"`` profile the StreamingSimulator resolves at
+    construction.  The measured objective is wall seconds for the
+    SAME swarm-total step budget — walks/s and steps/s rank
+    identically under it."""
+    from pulsar_tlaplus_tpu.sim.engine import StreamingSimulator
+
+    _log = log or (lambda msg: None)
+    t0 = time.perf_counter()
+    backend = tune_profiles.default_backend()
+    total = int(total_steps or 1024 * depth * 4)
+    ref = {
+        "backend": backend,
+        "A": int(getattr(model, "A", 1)),
+        "n_inv": len(
+            tuple(invariants)
+            or tuple(getattr(model, "default_invariants", ()))
+        ),
+        "depth": int(depth),
+        "total_steps": total,
+        "n_walkers": 1024,
+        "segment_len": min(depth, 32),
+    }
+    cal = calibration or attribution.default_calibration(backend)
+    ranked = tune_predict.rank_sim(tune_space.sim_candidates(), ref, cal)
+    by_key = {tune_space.describe(c): (c, p) for c, p in ranked}
+    order = [tune_space.describe(c) for c, _p in ranked]
+    measure = ["defaults"] + [
+        k for k in order if k != "defaults"
+    ][: max(top_k, 0)]
+    _log(
+        f"sim predict: {len(ranked)} candidate(s); measuring "
+        f"{len(measure)} (top-{top_k} + baseline)"
+    )
+
+    def _mk(cand: Dict):
+        return StreamingSimulator(
+            model,
+            invariants=tuple(invariants),
+            n_walkers=cand.get("n_walkers"),
+            depth=depth,
+            segment_len=cand.get("segment_len"),
+            max_steps=total,
+            telemetry=_stream(
+                stream_dir,
+                f"sim_{spec_label}_{tune_space.describe(cand)}",
+            ),
+            profile=None,  # the search must not load what it writes
+        )
+    sims = {k: _mk(by_key[k][0]) for k in measure}
+    walls: Dict[str, List[float]] = {k: [] for k in measure}
+    steps_ps: Dict[str, float] = {}
+    for _rep in range(max(repeat, 1)):
+        for key in measure:
+            rr = sims[key].run()
+            walls[key].append(float(rr.wall_s))
+            steps_ps[key] = max(
+                steps_ps.get(key, 0.0), float(rr.steps_per_sec)
+            )
+    measured = {k: min(v) for k, v in walls.items() if v}
+    base_s = measured.get("defaults")
+    winner_key = min(measured, key=lambda k: measured[k])
+    winner, _winner_pred = by_key[winner_key]
+    margin = (
+        (base_s - measured[winner_key]) / base_s * 100.0
+        if base_s
+        else 0.0
+    )
+    _log(
+        f"sim winner: {winner_key} at {measured[winner_key]:.3f}s "
+        f"(baseline {base_s:.3f}s, margin {margin:+.1f}%)"
+    )
+    sig = tune_profiles.profile_key(
+        model=model,
+        invariants=tuple(sims["defaults"].invariant_names),
+        engine="sim", backend=backend,
+    )
+    profile = tune_profiles.build(
+        sig=sig,
+        engine="sim",
+        backend=backend,
+        knobs=dict(winner),
+        spec=spec_label,
+        tuner={
+            "winner": winner_key,
+            "baseline_s": round(base_s, 4) if base_s else None,
+            "winner_s": round(measured[winner_key], 4),
+            "margin_pct": round(margin, 2),
+            "candidates_predicted": len(ranked),
+            "candidates_measured": len(measured),
+            "repeat": max(repeat, 1),
+            "total_steps": total,
+            "depth": depth,
+            "steps_per_sec": {
+                k: round(v, 1) for k, v in steps_ps.items()
+            },
+            "search_wall_s": round(time.perf_counter() - t0, 2),
+            "calibration_source": cal.get("source"),
+        },
+    )
+    tune_profiles.save(profile)
+    shown = [k for k in order if k in measured]
+    shown += [k for k in order if k not in measured][:15]
+    rows = []
+    for key in shown:
+        _cand, pred = by_key[key]
+        rows.append(
+            {
+                "candidate": key,
+                "est_s": pred["est_s"],
+                "dispatches": pred["dispatches"],
+                "measured_s": measured.get(key),
+                "winner": key == winner_key,
+            }
+        )
+    return profile, rows
+
+
 def _stream(stream_dir: Optional[str], label: str) -> Optional[str]:
     if not stream_dir:
         return None

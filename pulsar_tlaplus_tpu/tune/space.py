@@ -79,6 +79,40 @@ SPILL_KNOBS: Tuple[Knob, ...] = (
     ),
 )
 
+# swarm-simulation knobs (round 18, sim/engine.py — searched by
+# ``cli.py tune --mode simulate``): the swarm width trades per-step
+# parallelism against per-dispatch latency; the segment length
+# amortizes the dispatch+fetch round trip over more steps (it is
+# clamped to a divisor of ``depth`` at construction).  Neither knob
+# changes the walk stream's SEMANTICS — a different (n_walkers,
+# segment_len) is a different deterministic stream, which is why sim
+# profiles resolve by config signature exactly like engine profiles.
+SIM_KNOBS: Tuple[Knob, ...] = (
+    Knob(
+        "n_walkers", (None, 1024, 4096, 16384),
+        "walker swarm width (walks per dispatch)",
+    ),
+    Knob(
+        "segment_len", (None, 8, 32, 128),
+        "steps per dispatch (clamped to a depth divisor)",
+    ),
+)
+
+
+def sim_candidates(limit: Optional[int] = None) -> List[Dict]:
+    """The simulation knob space as sparse dicts (defaults first —
+    the baseline the tuner must beat), mirroring :func:`candidates`."""
+    out: List[Dict] = []
+    for combo in itertools.product(*(k.values for k in SIM_KNOBS)):
+        cand = {
+            k.name: v for k, v in zip(SIM_KNOBS, combo) if v is not None
+        }
+        out.append(cand)
+        if limit is not None and len(out) >= limit:
+            break
+    return out
+
+
 # liveness-engine knobs carried by profiles (loaded by
 # LivenessChecker; offline search over them is future work — the
 # device engine dominates exploration wall)
@@ -95,6 +129,7 @@ PROFILE_KNOBS: Dict[str, Tuple[str, ...]] = {
         "hbm_headroom", "spill_compress", "miss_batch",
     ),
     "liveness": ("sweep_group", "compact_impl", "adapt"),
+    "sim": ("n_walkers", "segment_len"),
 }
 
 

@@ -27,7 +27,8 @@ variable into synthetic faults fired at named host-side sites:
 Syntax: ``kind@site:count`` — ``site`` is a counter the engines
 advance (``level`` = the BFS level about to be expanded, ``flush`` =
 the flush sequence number, ``frame`` = the checkpoint frame sequence
-number, ``sweep`` = the liveness engine's edge-sweep chunk; since
+number, ``sweep`` = the liveness engine's edge-sweep chunk,
+``segment`` = the simulation engine's segment epoch (r18); since
 round 17 the SERVICE layer counts too: ``conn`` = the daemon's
 accepted-connection sequence, ``line`` = the daemon's sent-protocol-
 line sequence, ``persist`` = the scheduler's queue.json snapshot

@@ -50,7 +50,14 @@ spill writer fails loudly; r17: v10 run headers carry ``tenant`` —
 the bearer-token-derived tenant, null on standalone runs — and the
 hardened daemon emits ``admission`` (admit/reject/shed/dedup, with
 tenant + reason), ``auth`` (TCP handshake), and ``deadline`` (the
-deadline sweep cancelling an expired job) events — all
+deadline sweep cancelling an expired job) events; r18: v11 run headers carry
+``mode`` — the workload class (``check`` / ``liveness`` /
+``simulate``) — and the streaming simulation engine (sim/) emits
+``sim`` records whose counters (steps, states, walks, violations,
+stutter steps, enabled lanes, duplicate-estimator attempts/hits) are
+CUMULATIVE per run: the validator cross-checks monotonicity exactly
+like ``spill``, so a torn or re-based walk-stream writer fails
+loudly — all
 FIELD_SINCE-gated so
 older streams stay clean).  ``--trace``
 validates an exported Perfetto trace file's event structure instead
@@ -68,7 +75,10 @@ headline keys, >= 3 additionally the telemetry/survivability key set
 additionally ``fuse`` + ``dispatches_per_level``, >= 7 additionally
 the ``work_*`` unit totals (r14 attribution), >= 8 additionally
 the tiered-store keys (``hbm_budget``, ``spill_bytes_per_state``,
-``spill_overlap_ratio`` — null on untiered runs, keys required).
+``spill_overlap_ratio`` — null on untiered runs, keys required),
+>= 9 additionally the swarm-simulation throughput keys
+(``walks_per_sec``, ``steps_per_state`` — null on check-mode runs,
+keys required).
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -124,6 +134,9 @@ BENCH_KEYS_V7 = BENCH_KEYS_V6 + (
 BENCH_KEYS_V8 = BENCH_KEYS_V7 + (
     "hbm_budget", "spill_bytes_per_state", "spill_overlap_ratio",
 )
+# v9 (r18): the swarm-simulation throughput signals (null on
+# check-mode runs; the keys themselves are required)
+BENCH_KEYS_V9 = BENCH_KEYS_V8 + ("walks_per_sec", "steps_per_state")
 
 
 def _check_fused_levels(path: str, runs: dict) -> List[str]:
@@ -183,6 +196,13 @@ SPILL_CUMULATIVE = (
     "transfer_s", "misses_resolved",
 )
 
+# the sim record's cumulative counters (v11): each must be monotone
+# non-decreasing per run_id (the walk stream only moves forward)
+SIM_CUMULATIVE = (
+    "steps", "states", "walks", "violations", "stutter_steps",
+    "enabled_lanes", "dup_attempts", "dup_hits",
+)
+
 
 def validate_stream(path: str) -> List[str]:
     """All schema violations in one stream (empty list = clean)."""
@@ -191,6 +211,7 @@ def validate_stream(path: str) -> List[str]:
     last_seq: dict = {}
     fused_runs: dict = {}
     last_spill: dict = {}
+    last_sim: dict = {}
     n = 0
     try:
         f = open(path)
@@ -265,6 +286,24 @@ def validate_stream(path: str) -> List[str]:
                     errors.append(
                         f"{path}:{i}: {rec['event']} missing {miss}"
                     )
+            if rec["event"] == "sim" and isinstance(
+                rec.get("v"), int
+            ) and rec["v"] >= 11:
+                # v11 cross-check: sim counters are CUMULATIVE per run
+                # — a record whose steps/states go backwards is a torn
+                # writer or a silently re-based walk stream
+                prev = last_sim.setdefault(rec["run_id"], {})
+                for k in SIM_CUMULATIVE:
+                    cur = rec.get(k)
+                    if not isinstance(cur, (int, float)):
+                        continue
+                    if cur < prev.get(k, float("-inf")):
+                        errors.append(
+                            f"{path}:{i}: sim.{k} went backwards "
+                            f"for run {rec['run_id']} ({cur} < "
+                            f"{prev[k]} — cumulative contract)"
+                        )
+                    prev[k] = cur
             if rec["event"] == "spill" and isinstance(
                 rec.get("v"), int
             ) and rec["v"] >= 9:
@@ -327,7 +366,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 8:
+    if schema >= 9:
+        required = BENCH_KEYS_V9
+    elif schema >= 8:
         required = BENCH_KEYS_V8
     elif schema >= 7:
         required = BENCH_KEYS_V7

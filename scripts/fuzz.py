@@ -1,0 +1,400 @@
+#!/usr/bin/env python
+"""Differential fuzz harness — randomized .cfg constant bindings,
+device engine vs interpreter (round 18, ISSUE 14 satellite).
+
+For each of the four registered specs, seeded-randomly sample small
+constant bindings from the declared axes, then run the SAME binding
+through two independent implementations and cross-check:
+
+- the **device engine** (``engine/device_bfs.DeviceChecker`` — the
+  hand-compiled vmapped model on the JAX backend), and
+- the **interpreter**: the pure-Python reference evaluator for
+  compaction (``ref/pyeval.py``), the generic TLA+ interpreter over
+  the spec's own ``.tla`` source for the other three
+  (``engine/interp_check.InterpChecker``).
+
+Checked per binding: distinct-state count, diameter, verdict
+(violation name / deadlock / clean), violation-trace length, and the
+device engine's counterexample REPLAYED state-for-state through the
+interpreter's transition relation (every claimed action must be a
+real interpreter successor producing the same rendered state, and the
+invariant must hold until the final state).
+
+Usage:
+
+    python scripts/fuzz.py --seed 7 --per-spec 3            # sweep
+    python scripts/fuzz.py --seed 0 --per-spec 1 --spec compaction
+
+Exit status: 0 = every binding agreed, 1 = mismatches (listed on
+stderr as JSON), 2 = usage.  The pinned-seed fast drill runs in
+tier-1 (tests/test_sim.py); the randomized sweep is slow-marked.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import random
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC_DIR = os.path.join(ROOT, "specs")
+
+SPECS = ("compaction", "bookkeeper", "georeplication", "subscription")
+
+# engine geometry for every fuzz point: small caps, growth exercised
+DEVICE_KW = dict(
+    sub_batch=256, visited_cap=1 << 12, frontier_cap=1 << 10,
+    max_states=1 << 18,
+)
+# interpreter BFS is pure Python — bindings are sampled small enough
+# that this cap never binds on a correct implementation
+INTERP_MAX_STATES = 200_000
+
+
+# ------------------------------------------------------ binding axes
+
+
+def sample_binding(spec: str, rng: random.Random):
+    """One randomized constants object for ``spec`` (small shapes —
+    every axis value keeps the interpreter BFS in the seconds range)."""
+    if spec == "compaction":
+        from pulsar_tlaplus_tpu.ref.pyeval import Constants
+
+        producer = rng.random() < 0.7
+        return Constants(
+            message_sent_limit=rng.randint(1, 2 if not producer else 3),
+            compaction_times_limit=rng.randint(1, 3),
+            num_keys=rng.randint(1, 2),
+            num_values=rng.randint(1, 2),
+            retain_null_key=rng.random() < 0.5,
+            max_crash_times=rng.randint(0, 2),
+            model_producer=producer,
+            model_consumer=False,
+        )
+    if spec == "bookkeeper":
+        from pulsar_tlaplus_tpu.models.bookkeeper import (
+            BookkeeperConstants,
+        )
+
+        e = rng.randint(2, 3)
+        qw = rng.randint(1, e)
+        return BookkeeperConstants(
+            num_bookies=e,
+            write_quorum=qw,
+            ack_quorum=rng.randint(1, qw),
+            entry_limit=rng.randint(1, 2),
+            max_bookie_crashes=rng.randint(0, 2),
+        )
+    if spec == "georeplication":
+        from pulsar_tlaplus_tpu.models.georeplication import GeoConstants
+
+        return GeoConstants(
+            num_clusters=2,
+            publish_limit=rng.randint(1, 2),
+            max_replicator_crashes=rng.randint(0, 1),
+        )
+    if spec == "subscription":
+        from pulsar_tlaplus_tpu.models.subscription import (
+            SubscriptionConstants,
+        )
+
+        return SubscriptionConstants(
+            message_limit=rng.randint(1, 3),
+            max_crash_times=rng.randint(0, 2),
+        )
+    raise ValueError(f"unknown spec {spec!r}")
+
+
+def _model_of(spec: str, constants):
+    from pulsar_tlaplus_tpu.models import bookkeeper as bk
+    from pulsar_tlaplus_tpu.models import georeplication as geo
+    from pulsar_tlaplus_tpu.models import subscription as subm
+    from pulsar_tlaplus_tpu.models.compaction import CompactionModel
+
+    return {
+        "compaction": CompactionModel,
+        "bookkeeper": bk.BookkeeperModel,
+        "georeplication": geo.GeoreplicationModel,
+        "subscription": subm.SubscriptionModel,
+    }[spec](constants)
+
+
+def _interp_constants(spec: str, c) -> Dict[str, int]:
+    """Constants object -> the .tla CONSTANT bindings (the registry's
+    inverse mapping)."""
+    if spec == "bookkeeper":
+        return {
+            "NumBookies": c.num_bookies,
+            "WriteQuorum": c.write_quorum,
+            "AckQuorum": c.ack_quorum,
+            "EntryLimit": c.entry_limit,
+            "MaxBookieCrashes": c.max_bookie_crashes,
+        }
+    if spec == "georeplication":
+        return {
+            "NumClusters": c.num_clusters,
+            "PublishLimit": c.publish_limit,
+            "MaxReplicatorCrashes": c.max_replicator_crashes,
+        }
+    if spec == "subscription":
+        return {
+            "MessageLimit": c.message_limit,
+            "MaxCrashTimes": c.max_crash_times,
+        }
+    raise ValueError(spec)
+
+
+_MODULES: Dict[str, object] = {}
+
+
+def _parsed_module(spec: str):
+    mod = _MODULES.get(spec)
+    if mod is None:
+        from pulsar_tlaplus_tpu.frontend.parser import parse_file
+
+        mod = parse_file(os.path.join(SPEC_DIR, f"{spec}.tla"))
+        _MODULES[spec] = mod
+    return mod
+
+
+# ------------------------------------------------------- the two runs
+
+
+def device_result(spec: str, constants, invariants):
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+
+    model = _model_of(spec, constants)
+    return DeviceChecker(
+        model,
+        invariants=invariants,
+        # pyeval has no deadlock analysis, so the compaction
+        # cross-check compares pure invariant semantics
+        check_deadlock=(spec != "compaction"),
+        **DEVICE_KW,
+    ).run()
+
+
+def interp_result(spec: str, constants, invariants):
+    """(result, replayer) — the replayer re-walks a device trace
+    through THIS interpreter's transition relation."""
+    if spec == "compaction":
+        from pulsar_tlaplus_tpu.ref import pyeval as pe
+
+        res = pe.check(
+            constants, invariants=invariants,
+            max_states=INTERP_MAX_STATES,
+        )
+
+        def replay(trace, actions, invariant) -> Optional[str]:
+            inits = set(pe.initial_states(constants))
+            if not trace or trace[0] not in inits:
+                return "trace does not start at an initial state"
+            inv = pe.INVARIANTS[invariant]
+            for s, act, t in zip(trace, actions, trace[1:]):
+                succ = {}
+                for a, st in pe.successors(constants, s):
+                    succ.setdefault(pe.ACTION_NAMES[a], []).append(st)
+                if t not in succ.get(act, []):
+                    return f"step {act!r} is not an interpreter successor"
+                if not inv(constants, s):
+                    return "invariant fails before the final state"
+            if inv(constants, trace[-1]):
+                return "invariant holds on the final state"
+            return None
+
+        return res, replay
+
+    from pulsar_tlaplus_tpu.engine.interp_check import InterpChecker
+    from pulsar_tlaplus_tpu.frontend.interp import Spec, install_defs
+
+    spec_obj = Spec(
+        _parsed_module(spec), _interp_constants(spec, constants)
+    )
+    res = InterpChecker(
+        spec_obj, invariants=invariants,
+        max_states=INTERP_MAX_STATES,
+    ).run()
+    model = _model_of(spec, constants)
+    install_defs(spec_obj)
+
+    def replay(trace, actions, _invariant) -> Optional[str]:
+        # device trace states are model pystates; render interpreter
+        # states the same way and walk label-matched successors
+        rendered = lambda t: model.to_pystate(model.from_interp_state(t))
+        cur = None
+        for s0 in spec_obj.initial_states():
+            if rendered(s0) == trace[0]:
+                cur = s0
+                break
+        if cur is None:
+            return "trace does not start at an initial state"
+        for act, want in zip(actions, trace[1:]):
+            nxt = [
+                t
+                for lab, t in spec_obj.successors(cur)
+                if lab == act and rendered(t) == want
+            ]
+            if not nxt:
+                return f"step {act!r} is not an interpreter successor"
+            cur = nxt[0]
+        return None
+
+    return res, replay
+
+
+def fuzz_one(spec: str, constants) -> Dict[str, object]:
+    """One binding through both implementations; returns the record
+    (``mismatches`` empty = agreement)."""
+    model = _model_of(spec, constants)
+    invariants = tuple(model.default_invariants)
+    binding = (
+        dataclasses.asdict(constants)
+        if dataclasses.is_dataclass(constants)
+        else repr(constants)
+    )
+    rec: Dict[str, object] = {
+        "spec": spec,
+        "binding": binding,
+        "invariants": list(invariants),
+    }
+    mism: List[str] = []
+    rd = device_result(spec, constants, invariants)
+    ri, replay = interp_result(spec, constants, invariants)
+    rec["device"] = {
+        "distinct_states": rd.distinct_states,
+        "diameter": rd.diameter,
+        "violation": rd.violation,
+        "deadlock": bool(rd.deadlock),
+        "trace_len": len(rd.trace) if rd.trace else None,
+    }
+    rec["interp"] = {
+        "distinct_states": ri.distinct_states,
+        "diameter": ri.diameter,
+        "violation": ri.violation,
+        "deadlock": bool(getattr(ri, "deadlock", False)),
+        "trace_len": len(ri.trace) if ri.trace else None,
+    }
+    if rd.violation != ri.violation:
+        mism.append(
+            f"verdict: device={rd.violation!r} interp={ri.violation!r}"
+        )
+    if spec != "compaction" and bool(rd.deadlock) != bool(
+        getattr(ri, "deadlock", False)
+    ):
+        mism.append(
+            f"deadlock: device={rd.deadlock} "
+            f"interp={getattr(ri, 'deadlock', False)}"
+        )
+    if rd.violation is None and ri.violation is None and not rd.deadlock:
+        # clean runs must agree exactly on the explored space
+        if rd.distinct_states != ri.distinct_states:
+            mism.append(
+                f"distinct_states: device={rd.distinct_states} "
+                f"interp={ri.distinct_states}"
+            )
+        if rd.diameter != ri.diameter:
+            mism.append(
+                f"diameter: device={rd.diameter} interp={ri.diameter}"
+            )
+    if rd.violation and ri.violation and rd.violation == ri.violation:
+        # both found it: shortest traces must be the same LENGTH (the
+        # states may differ — BFS ties), and the device counterexample
+        # must replay state-for-state through the interpreter
+        if rd.trace is not None and ri.trace is not None and (
+            len(rd.trace) != len(ri.trace)
+        ):
+            mism.append(
+                f"trace length: device={len(rd.trace)} "
+                f"interp={len(ri.trace)}"
+            )
+        if rd.trace is not None:
+            err = replay(rd.trace, rd.trace_actions, rd.violation)
+            if err:
+                mism.append(f"device trace replay: {err}")
+    rec["mismatches"] = mism
+    return rec
+
+
+def run(
+    seed: int,
+    per_spec: int,
+    specs: Tuple[str, ...] = SPECS,
+    log=None,
+) -> Tuple[List[Dict], List[Dict]]:
+    """The sweep: ``per_spec`` sampled bindings per spec, one shared
+    seeded RNG (the whole sweep replays from ``--seed``).  Returns
+    (all records, failing records)."""
+    _log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+    rng = random.Random(seed)
+    records: List[Dict] = []
+    for spec in specs:
+        done = 0
+        while done < per_spec:
+            try:
+                constants = sample_binding(spec, rng)
+                if hasattr(constants, "validate"):
+                    constants.validate()
+            except ValueError:
+                continue  # invalid corner of the axes: resample
+            rec = fuzz_one(spec, constants)
+            records.append(rec)
+            done += 1
+            _log(
+                f"fuzz {spec} #{done}: "
+                f"{rec['device']['distinct_states']} states, "
+                f"verdict={rec['device']['violation'] or 'clean'}"
+                + (
+                    f"  MISMATCH: {rec['mismatches']}"
+                    if rec["mismatches"]
+                    else ""
+                )
+            )
+    failures = [r for r in records if r["mismatches"]]
+    return records, failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="differential fuzz: randomized constant bindings, "
+        "device engine vs interpreter, over the four registered specs"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--per-spec", type=int, default=3,
+        help="sampled bindings per spec (default 3)",
+    )
+    ap.add_argument(
+        "--spec", action="append", default=None,
+        help=f"restrict to this spec (repeatable; known: {SPECS})",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print every record as JSON on stdout",
+    )
+    args = ap.parse_args(argv)
+    specs = tuple(args.spec) if args.spec else SPECS
+    unknown = [s for s in specs if s not in SPECS]
+    if unknown:
+        ap.error(f"unknown spec(s) {unknown} (known: {SPECS})")
+    records, failures = run(args.seed, args.per_spec, specs)
+    if args.json:
+        print(json.dumps(records, default=str))
+    for f in failures:
+        print(json.dumps(f, default=str), file=sys.stderr)
+    print(
+        f"{len(records)} binding(s), {len(failures)} mismatch(es)",
+        file=sys.stderr,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
