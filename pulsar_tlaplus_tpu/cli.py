@@ -705,10 +705,15 @@ def _print_job_line(j: dict) -> None:
             )
     elif j.get("error"):
         extra = f"  {j['error'][:80]}"
+    warm = ""
+    if j.get("warm_mode"):
+        # the reuse decision (docs/incremental.md): continue / reseed
+        # with its match, or cold with the typed fallback reason
+        warm = f" warm={j['warm_mode']}:{j.get('warm_reason')}"
     print(
         f"{j['job_id']}  {j['spec']:<16} {j['state']:<10} "
         f"slices={j.get('slices', 0)} suspends={j.get('suspends', 0)}"
-        f"{extra}"
+        f"{warm}{extra}"
     )
 
 
@@ -799,6 +804,11 @@ def _cmd_serve(args) -> int:
         tenant_max_queued=args.tenant_max_queued,
         tenant_max_running=args.tenant_max_running,
         tenant_max_states=args.tenant_max_states,
+        **(
+            {"warm_max_bytes": args.warm_max_bytes}
+            if args.warm_max_bytes is not None
+            else {}
+        ),
     )
     try:
         daemon = ServiceDaemon(config, recover=args.recover, log=log)
@@ -838,7 +848,7 @@ def _cmd_submit(args) -> int:
         }
     cl = _service_client(args)
     try:
-        jid = cl.submit(
+        reply = cl.submit(
             args.spec,
             os.path.abspath(args.config),
             invariants=args.invariant,
@@ -849,7 +859,10 @@ def _cmd_submit(args) -> int:
             submit_id=args.submit_id,
             mode=args.mode,
             sim=sim,
+            warm=not args.no_warm,
+            full=True,
         )
+        jid = reply["job_id"]
     except (ServiceError, OSError) as e:
         # distinct exit codes for rejected-at-the-door (docs/
         # service.md "Admission"): 4 = bad/missing token, 5 = over
@@ -857,6 +870,14 @@ def _cmd_submit(args) -> int:
         # "back off" from "the daemon is down" (2) without parsing
         _client_fail("submit", e)
     print(jid)
+    if reply.get("warm_mode"):
+        # the reuse plan, up front (docs/incremental.md): continue /
+        # reseed with its match, or cold with the typed reason
+        print(
+            f"warm plan: {reply['warm_mode']} "
+            f"({reply.get('warm_reason')})",
+            file=sys.stderr,
+        )
     if args.watch:
         return _watch_stream(cl, jid, args.timeout)
     if args.wait:
@@ -1133,14 +1154,21 @@ def _cmd_ledger(args) -> int:
                         and ledger.baseline_matches_profile(
                             r, args.profile, cur
                         )
+                        # warm-start context (r19): a warm-continue
+                        # partial never baselines a cold run (and
+                        # vice versa) — its counters cover only the
+                        # resumed suffix of the search
+                        and ledger.baseline_matches_warm(r, cur)
                     ),
                     None,
                 )
                 if base is None:
                     print(
                         "tpu-tlc: no baseline with a matching config "
-                        f"key and profile context ({args.profile!r}) "
-                        "in the ledger (pass --baseline REF)",
+                        f"key, profile context ({args.profile!r}), "
+                        "and warm context "
+                        f"({ledger.warm_of(cur)!r}) in the ledger "
+                        "(pass --baseline REF)",
                         file=sys.stderr,
                     )
                     return 2
@@ -1520,6 +1548,12 @@ def main(argv=None):
         "growth tiers lazy-compile)",
     )
     ps.add_argument(
+        "--warm-max-bytes", type=int, default=None, metavar="BYTES",
+        help="LRU byte cap on the warm-artifact store (incremental "
+        "checking, docs/incremental.md; default 1 GiB; 0 disables "
+        "the warm layer — no artifacts, every submit runs cold)",
+    )
+    ps.add_argument(
         "--no-profiles", action="store_true",
         help="skip tuned-profile resolution when building pooled "
         "checkers (profiles otherwise shape the prewarmed "
@@ -1589,6 +1623,12 @@ def main(argv=None):
         "--deadline-s", type=float, default=None, metavar="SEC",
         help="wall-clock deadline from submit; past it the job is "
         "cancelled with stop_reason=deadline (exit 3, no verdict)",
+    )
+    pj.add_argument(
+        "--no-warm", action="store_true",
+        help="opt this job out of warm-start reuse AND artifact "
+        "harvesting: always a full cold recheck "
+        "(docs/incremental.md)",
     )
     pj.add_argument(
         "--submit-id", default=None, metavar="ID",

@@ -558,6 +558,16 @@ class DeviceChecker:
         # preemption-safe shutdown
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
+        # incremental checking (warm/): write one frame at CLEAN
+        # completion too (empty frontier) so a completed run leaves a
+        # reseed-able warm artifact — budget truncations already frame
+        self.final_frame = False
+        # a warm-RESEEDED run's seed merges the artifact's trailing
+        # levels into one frontier level, so its level count no longer
+        # bounds the parent-chain depth — the installer raises this by
+        # the artifact's original level count so trace walks reach the
+        # roots (warm/plan.build_reseed_seed)
+        self.extra_trace_depth = 0
         self._ckpt_frames = 0
         self._ckpt_bytes = 0
         self._ckpt_retries = 0
@@ -2633,6 +2643,7 @@ class DeviceChecker:
             # daemon scheduler, None on standalone runs — always
             # present so per-tenant attribution never needs a join
             tenant=getattr(self, "tenant", None),
+            warm=getattr(self, "warm", None),
             # workload class (r18, schema v11): always "check" here —
             # the streaming walker swarm (sim/) is its own engine
             mode="check",
@@ -3430,6 +3441,14 @@ class DeviceChecker:
                     )
                 return self._result(t0, nv, level_sizes, bufs, **reason)
             if nf == 0:
+                if self.final_frame:
+                    # the search is COMPLETE (empty frontier): the
+                    # frame exists purely as the warm-reseed artifact
+                    # — full fingerprint planes + rows, zero frontier
+                    self._save_frame(
+                        bufs, st, rb, level_sizes, level_base, 0, nv,
+                        t0,
+                    )
                 return self._result(t0, nv, level_sizes, bufs)
             if (
                 self.tstore is not None
@@ -4712,7 +4731,9 @@ class DeviceChecker:
                 res.truncated = True
             else:
                 res.trace, res.trace_actions = self._trace(
-                    bufs, gid, len(level_sizes) + 2
+                    bufs, gid,
+                    len(level_sizes) + 2
+                    + int(getattr(self, "extra_trace_depth", 0)),
                 )
         # fused-era cost attribution (r14): one machine-readable record
         # of the per-stage work-unit totals right before the result —

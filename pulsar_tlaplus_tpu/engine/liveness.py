@@ -1073,6 +1073,7 @@ class LivenessChecker:
             hbm_budget=getattr(self._checker, "hbm_budget", None),
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
+            warm=getattr(self, "warm", None),
             # v11: workload class (two-phase liveness check)
             mode="liveness",
             wall_unix=round(time.time(), 3),

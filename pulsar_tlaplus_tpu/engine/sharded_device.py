@@ -1933,6 +1933,7 @@ class ShardedDeviceChecker:
             hbm_budget=None,
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
+            warm=getattr(self, "warm", None),
             # v11: workload class (exhaustive BFS)
             mode="check",
             wall_unix=round(time.time(), 3),

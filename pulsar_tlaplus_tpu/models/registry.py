@@ -87,3 +87,92 @@ COMPILED: Dict[str, Callable] = {
     "bookkeeper": _bookkeeper,
     "georeplication": _georeplication,
 }
+
+
+# ------------------------------------------------ incremental checking
+#
+# Declared MONOTONE constant axes (docs/incremental.md): widening the
+# cfg CONSTANT along one of these axes is guaranteed to (a) leave every
+# previously reachable state reachable with its packed encoding intact
+# (as long as the packed layout is bit-identical — the warm planner
+# verifies that separately, since a bitlen() step on the counter field
+# changes the layout), and (b) enable NEW transitions only from states
+# where the named counter field is SATURATED at the old bound.  The
+# declaration is a per-model proof obligation, not an inference: every
+# axis below gates exactly one action through `counter < LIMIT` whose
+# successor function does not read the limit, and appears in invariants
+# only as an upper bound (`counter <= LIMIT`, which only weakens under
+# widening).  `scripts/fuzz.py --widen` differentially re-verifies the
+# obligation on randomized widenings.
+
+class MonotoneAxis:
+    """One declared-monotone constant: the cfg CONSTANT name, the
+    packed-state field holding its progress counter, and how saturation
+    is read off the field (``counter`` = the scalar field value,
+    ``popcount`` = the sum of a 0/1 vector field)."""
+
+    def __init__(self, constant: str, field: str, kind: str = "counter"):
+        if kind not in ("counter", "popcount"):
+            raise ValueError(f"unknown axis kind {kind!r}")
+        self.constant = constant
+        self.field = field
+        self.kind = kind
+
+    def __repr__(self):
+        return (
+            f"MonotoneAxis({self.constant!r}, {self.field!r}, "
+            f"{self.kind!r})"
+        )
+
+
+MONOTONE_AXES: Dict[str, Tuple[MonotoneAxis, ...]] = {
+    # compaction.tla: MaxCrashTimes gates BrokerCrash alone
+    # (models/compaction.py `s.crash < max_crash_times`); invariant use
+    # is the `crash <= max` type bound only
+    "compaction": (MonotoneAxis("MaxCrashTimes", "crash"),),
+    # subscription: MaxCrashTimes gates the consumer-crash action
+    # (models/subscription.py `s.crash < max_crash_times`)
+    "subscription": (MonotoneAxis("MaxCrashTimes", "crash"),),
+    # bookkeeper: MaxBookieCrashes gates BookieCrash via the CRASHED
+    # POPULATION (`sum(crashed) < max`); the field is the per-bookie
+    # 0/1 vector, so the layout never depends on the bound at all
+    "bookkeeper": (
+        MonotoneAxis("MaxBookieCrashes", "crashed", kind="popcount"),
+    ),
+    # georeplication: MaxReplicatorCrashes gates ReplicatorCrash
+    "georeplication": (
+        MonotoneAxis("MaxReplicatorCrashes", "crash"),
+    ),
+}
+
+
+def module_digest(spec: str) -> str:
+    """SHA-256 identity of a registry spec's SEMANTICS as shipped: the
+    compiled model's defining Python source plus the vendored ``.tla``
+    module when present (and, for compaction, the reference evaluator
+    the model mirrors).  Any edit to either — a re-guarded action, a
+    new invariant definition — changes the digest, which is exactly
+    what forces the warm planner's cold fallback (docs/incremental.md:
+    "a module edit is never warm-started")."""
+    import hashlib
+    import importlib
+    import os
+
+    if spec not in COMPILED:
+        raise ValueError(f"unknown registry spec {spec!r}")
+    mods = [importlib.import_module(f"pulsar_tlaplus_tpu.models.{spec}")]
+    if spec == "compaction":
+        mods.append(importlib.import_module("pulsar_tlaplus_tpu.ref.pyeval"))
+    h = hashlib.sha256()
+    for m in mods:
+        with open(m.__file__, "rb") as f:
+            h.update(f.read())
+    tla = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "..", "specs", f"{spec}.tla",
+    )
+    tla = os.path.normpath(tla)
+    if os.path.exists(tla):
+        with open(tla, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()

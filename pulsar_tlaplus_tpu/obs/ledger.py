@@ -197,6 +197,11 @@ def record_from_stream(events: List[dict], source: str = "") -> dict:
         # tuned-profile attribution (r15, schema v8): lets list/
         # compare/gate split tuned vs default trajectories
         values["profile_sig"] = hd["profile_sig"]
+    if hd.get("warm"):
+        # warm-start attribution (r19, schema v12): a warm-continue
+        # run's counters cover only the continued SUFFIX of the
+        # search — gate must never baseline a cold run against one
+        values["warm"] = hd["warm"]
     values = {
         k: v for k, v in values.items() if isinstance(v, _SCALAR)
     }
@@ -404,6 +409,24 @@ def baseline_matches_profile(rec: dict, want: str, cur: dict) -> bool:
     if want == "none":
         return p is None
     return p is not None and p.startswith(want)
+
+
+def warm_of(rec: dict) -> str:
+    """A record's warm-start context, normalized: ``continue`` /
+    ``reseed`` for warm-started runs, ``cold`` for everything else
+    (including every pre-v12 record)."""
+    w = (rec.get("values") or {}).get("warm")
+    return str(w) if w in ("continue", "reseed") else "cold"
+
+
+def baseline_matches_warm(rec: dict, cur: dict) -> bool:
+    """Whether ``rec`` is an acceptable default-gate baseline for
+    ``cur`` under the warm-start context: like-for-like only.  A
+    warm-CONTINUE record's wall/rate/dispatch counters cover only the
+    resumed suffix of the search, so letting one baseline a cold run
+    (or vice versa) would make every gate comparison structurally
+    meaningless — the r19 ledger-hardening satellite."""
+    return warm_of(rec) == warm_of(cur)
 
 
 def render_list(recs: List[dict], key: Optional[str] = None) -> str:

@@ -288,6 +288,46 @@ def _admission_families(
     return [f_adm, f_rej, f_shed, f_dedup]
 
 
+def _warm_families(
+    counts: Dict[Tuple[str, str], float],
+    cache_bytes: Optional[float] = None,
+) -> List[Family]:
+    """The r19 incremental-checking families: one counter per warm
+    outcome — ``hit`` (continue), ``reseed``, ``cold`` — labelled by
+    the machine-readable reason, plus the artifact store's byte
+    gauge.  Identically named from the live daemon and a stream tail
+    (docs/incremental.md / docs/observability.md)."""
+    fams = {
+        "continue": Family(
+            "ptt_warm_hit_total", "counter",
+            "Jobs warm-started by resuming an artifact frame "
+            "(continue mode), by reason",
+        ),
+        "reseed": Family(
+            "ptt_warm_reseed_total", "counter",
+            "Jobs warm-started across a constant widening (reseed "
+            "mode), by reason",
+        ),
+        "cold": Family(
+            "ptt_warm_cold_total", "counter",
+            "Jobs that ran a full cold recheck, by typed reason",
+        ),
+    }
+    for (mode, reason), n in sorted(counts.items()):
+        fam = fams.get(mode)
+        if fam is not None:
+            fam.add(n, {"reason": str(reason)})
+    out = list(fams.values())
+    if cache_bytes is not None:
+        out.append(
+            Family(
+                "ptt_warm_cache_bytes", "gauge",
+                "Warm-artifact store size on disk",
+            ).add(cache_bytes)
+        )
+    return out
+
+
 # ------------------------------------------------------- daemon scrape
 
 
@@ -400,6 +440,16 @@ def scheduler_metrics(
         fams += _admission_families(
             snap_adm["admitted"], rejected, snap_adm["deduped"]
         )
+    wc = dict(getattr(sched, "warm_counts", None) or {})
+    wstore = getattr(sched, "warm_store", None)
+    if wc or wstore is not None:
+        wbytes = None
+        if wstore is not None:
+            try:
+                wbytes = wstore.total_bytes()
+            except OSError:
+                wbytes = None
+        fams += _warm_families(wc, wbytes)
     fams.append(
         Family(
             "ptt_persist_failures_total", "counter",
@@ -429,8 +479,20 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     adm_admitted: Dict[str, float] = {}
     adm_rejected: Dict[Tuple[str, str], float] = {}
     adm_deduped: Dict[str, float] = {}
+    warm_counts: Dict[Tuple[str, str], float] = {}
     for e in events:
         ev = e.get("event")
+        if ev == "warm":
+            # mirror the live daemon's counting points exactly: a cold
+            # PLAN is final (the job never reaches install), a
+            # continue/reseed plan counts at INSTALL where the digest
+            # verify decides hit vs demoted-cold
+            phase = e.get("phase")
+            if (phase == "plan" and e.get("mode") == "cold") or (
+                phase == "install"
+            ):
+                key = (str(e.get("mode")), str(e.get("reason")))
+                warm_counts[key] = warm_counts.get(key, 0) + 1
         if ev == "admission":
             tenant = str(e.get("tenant", "?"))
             action = e.get("action")
@@ -526,6 +588,8 @@ def stream_metrics(events: List[dict]) -> List[Family]:
         fams += _admission_families(
             adm_admitted, adm_rejected, adm_deduped
         )
+    if warm_counts:
+        fams += _warm_families(warm_counts)
 
     # daemon streams additionally carry the job lifecycle
     from pulsar_tlaplus_tpu.obs import report

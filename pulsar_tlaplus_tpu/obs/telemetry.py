@@ -116,10 +116,22 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # cross-check monotonicity exactly like ``spill`` (a sim record whose
 # counters go backwards is a torn writer or a silently re-based walk
 # stream; docs/simulation.md).
+# v12 (round 19, incremental checking): run headers carry ``warm`` —
+# the warm-start mode the run executed under (``continue`` when it
+# resumed a prior run's artifact frame, ``reseed`` when it was seeded
+# from a prior fingerprint set across a constant widening, null on
+# cold/standalone runs; REQUIRED at v12 like profile_sig / hbm_budget /
+# tenant / mode so warm trajectories always split — and so the ledger
+# can refuse a warm-continue partial as a cold run's gate baseline) —
+# and the daemon emits one ``warm`` event per reuse decision: the
+# planned/installed mode with a machine-readable reason (``sig_match``,
+# ``widened:AXIS``, or the cold fallback reason — module_edit,
+# invariant_change, binding_change, narrowed, layout_change,
+# digest_mismatch, torn_artifact, ... — docs/incremental.md).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -194,6 +206,13 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("sim", "steps"): 11,
     ("sim", "walkers"): 11,
     ("sim", "violations"): 11,
+    # v12 (round 19): the warm-start mode on every run header (null on
+    # cold/standalone runs) and the daemon's per-decision ``warm``
+    # event — gated so every committed v11-and-older stream stays
+    # clean.
+    ("run_header", "warm"): 12,
+    ("warm", "mode"): 12,
+    ("warm", "reason"): 12,
     ("admission", "action"): 10,
     ("admission", "tenant"): 10,
     ("auth", "action"): 10,
@@ -212,7 +231,7 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # hbm_budget — the tiered-store byte budget, null when untiered)
     "run_header": (
         "engine", "visited_impl", "config_sig", "profile_sig",
-        "hbm_budget", "tenant", "mode",
+        "hbm_budget", "tenant", "mode", "warm",
     ),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress
@@ -302,6 +321,12 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "admission": ("action", "tenant"),
     "auth": ("action",),
     "deadline": ("job_id",),
+    # incremental checking (r19, warm/): one record per reuse decision
+    # in the daemon's stream — ``phase`` distinguishes the submit-time
+    # plan from the install-time outcome, ``mode`` is
+    # continue/reseed/cold, ``reason`` the machine-readable cause
+    # (sig_match / widened:AXIS / the typed cold-fallback reason)
+    "warm": ("mode", "reason"),
 }
 
 

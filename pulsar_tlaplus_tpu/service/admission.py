@@ -40,6 +40,39 @@ REASON_TENANT_QUEUED = "tenant_queued"
 REASON_TENANT_RUNNING = "tenant_running"
 REASON_TENANT_STATES = "tenant_states"
 
+# sim-job pricing defaults (mirror sim/engine.py: n_walkers resolves
+# to 1024 when neither the submit nor a tuned profile pins it, depth
+# to 64, and the legacy no-budget contract is ONE depth-round =
+# B * (depth + 1) swarm states)
+SIM_DEFAULT_WALKERS = 1024
+SIM_DEFAULT_DEPTH = 64
+
+
+def state_price(
+    max_states: Optional[int],
+    mode: str = "check",
+    sim: Optional[dict] = None,
+    default: int = 0,
+) -> int:
+    """One job's admission price in state units.
+
+    Check jobs price at their requested ``max_states`` (or the service
+    default).  Simulation jobs price at their ACTUAL swarm budget —
+    ``max_steps`` when set, else the legacy one-round total
+    ``n_walkers * (depth + 1)`` — instead of the BFS default
+    ``max_states`` (the r18 NOTE: a 16-walker depth-64 smoke job was
+    being priced like a 50M-state BFS run, which let one sim submit
+    eat a tenant's whole aggregate quota)."""
+    if mode == "simulate":
+        sim = sim or {}
+        steps = sim.get("max_steps")
+        if steps is None:
+            walkers = int(sim.get("n_walkers") or SIM_DEFAULT_WALKERS)
+            depth = int(sim.get("depth") or SIM_DEFAULT_DEPTH)
+            steps = walkers * (depth + 1)
+        return int(steps)
+    return int(max_states or default)
+
 
 class AdmissionError(ValueError):
     """A submit rejected at the door.  ``code`` is the wire error
@@ -79,10 +112,20 @@ class AdmissionControl:
 
     # ------------------------------------------------------- decisions
 
-    def check(self, tenant: str, max_states: Optional[int],
-              jobs: List) -> None:
+    def price(self, job) -> int:
+        """One live job's state-budget price (:func:`state_price` on
+        the job's own mode/knobs)."""
+        return state_price(
+            job.max_states,
+            getattr(job, "mode", "check"),
+            getattr(job, "sim", None),
+            self.default_max_states,
+        )
+
+    def check(self, tenant: str, asking: int, jobs: List) -> None:
         """Raise :class:`AdmissionError` when admitting one more job
-        for ``tenant`` would break a quota.  ``jobs`` is the live job
+        for ``tenant`` would break a quota.  ``asking`` is the
+        incoming job's :func:`state_price`; ``jobs`` is the live job
         table (the caller holds the scheduler cv)."""
         alive = [j for j in jobs if not j.terminal]
         if self.queue_cap and len(alive) >= self.queue_cap:
@@ -126,11 +169,8 @@ class AdmissionControl:
                     tenant=tenant,
                 )
         if self.tenant_max_states:
-            budget = sum(
-                int(j.max_states or self.default_max_states)
-                for j in mine
-            )
-            asking = int(max_states or self.default_max_states)
+            budget = sum(self.price(j) for j in mine)
+            asking = int(asking)
             if budget + asking > self.tenant_max_states:
                 self._count_reject(tenant, REASON_TENANT_STATES)
                 raise AdmissionError(

@@ -184,13 +184,19 @@ class ServiceClient:
         submit_id: Optional[str] = None,
         mode: str = "check",
         sim: Optional[dict] = None,
+        warm: bool = True,
+        full: bool = False,
     ) -> str:
         """Queue a job.  ``submit_id`` (auto-generated when omitted)
         makes the submit idempotent: the retry a dropped reply forces
         returns the SAME job_id instead of enqueueing twice.
         ``mode="simulate"`` queues a streaming walker-swarm job;
         ``sim`` carries its knobs (n_walkers, depth, segment_len,
-        seed, max_steps — docs/simulation.md)."""
+        seed, max_steps — docs/simulation.md).  ``warm=False``
+        (``--no-warm``) opts the job out of warm-start reuse AND
+        artifact harvesting; ``full=True`` returns the whole reply —
+        including the daemon's ``warm_mode``/``warm_reason`` reuse
+        plan — instead of just the job id (docs/incremental.md)."""
         r = self._request(
             "submit",
             spec=spec,
@@ -202,9 +208,10 @@ class ServiceClient:
             deadline_s=deadline_s,
             submit_id=submit_id or uuid.uuid4().hex,
             mode=mode,
+            warm=bool(warm),
             **({"sim": sim} if sim else {}),
         )
-        return r["job_id"]
+        return r if full else r["job_id"]
 
     def status(self, job_id: Optional[str] = None):
         r = self._request(

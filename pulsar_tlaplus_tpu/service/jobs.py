@@ -74,6 +74,21 @@ class Job:
     # (n_walkers, depth, segment_len, seed, max_steps)
     mode: str = "check"
     sim: Optional[dict] = None
+    # incremental checking (r19, warm/): ``warm`` is the submit-time
+    # opt-in (False = --no-warm: never reuse, never harvest);
+    # ``warm_mode`` is what the planner chose (continue/reseed/cold,
+    # demoted at install if the artifact fails its digest verify),
+    # ``warm_reason`` the machine-readable cause, ``warm_artifact``
+    # the planned artifact dir, ``warm_widened`` the axis -> [old,
+    # new] widening map a reseed replays over
+    warm: bool = True
+    warm_mode: Optional[str] = None
+    warm_reason: Optional[str] = None
+    warm_artifact: Optional[str] = None
+    warm_widened: Optional[dict] = None
+    # a reseeded job's trace-depth allowance: the artifact's original
+    # level count (its merged seed levels no longer bound chain depth)
+    warm_seed_levels: Optional[int] = None
     state: str = QUEUED
     submitted_unix: float = field(default_factory=lambda: time.time())
     started_unix: Optional[float] = None
@@ -140,6 +155,9 @@ class Job:
             "run_ids": list(self.run_ids),
             "wall_s": round(self.wall_s, 3),
         }
+        if self.warm_mode is not None:
+            s["warm_mode"] = self.warm_mode
+            s["warm_reason"] = self.warm_reason
         if self.deadline_unix is not None:
             s["deadline_unix"] = round(self.deadline_unix, 3)
         if self.error:

@@ -45,6 +45,8 @@ from pulsar_tlaplus_tpu.service import jobs as jobmod
 from pulsar_tlaplus_tpu.service.jobs import Job
 from pulsar_tlaplus_tpu.tune import profiles as tune_profiles
 from pulsar_tlaplus_tpu.utils import faults
+from pulsar_tlaplus_tpu.warm import plan as warm_plan
+from pulsar_tlaplus_tpu.warm import store as warm_store
 
 
 def _write_json_atomic(path: str, obj, _inject=None):
@@ -112,6 +114,11 @@ class ServiceConfig:
     #   status/result queries; oldest beyond this are pruned (table,
     #   queue.json, AND their jobs/<id>/ dirs) — a resident daemon
     #   must not grow per-submit forever.  0 disables pruning.
+    # incremental checking (r19, warm/, docs/incremental.md): the warm
+    # artifact store's LRU byte cap (`serve --warm-max-bytes`, the
+    # aot_cache precedent).  0 disables the warm layer entirely —
+    # no artifacts harvested, every submit plans cold.
+    warm_max_bytes: int = warm_store.DEFAULT_MAX_BYTES
     telemetry_path: str = ""  # default: <state_dir>/service.jsonl
 
     def __post_init__(self):
@@ -139,6 +146,10 @@ class ServiceConfig:
     @property
     def queue_path(self) -> str:
         return os.path.join(self.state_dir, "queue.json")
+
+    @property
+    def warm_dir(self) -> str:
+        return os.path.join(self.state_dir, "warm")
 
 
 class CheckerPool:
@@ -363,6 +374,25 @@ class Scheduler:
             tenant_max_states=config.tenant_max_states,
             default_max_states=config.max_states,
         )
+        # warm reuse layer (r19, docs/incremental.md): digest-verified
+        # artifacts under <state_dir>/warm, swept at startup so a torn
+        # artifact from a crashed harvest can never be reused; the
+        # (mode, reason) counters back ptt_warm_{hit,reseed,cold}_total
+        self.warm_store = None
+        self.warm_counts: Dict[Tuple[str, str], int] = {}
+        self._mod_digests: Dict[str, str] = {}
+        self._warm_lock = threading.Lock()
+        if config.warm_max_bytes > 0:
+            self.warm_store = warm_store.WarmStore(
+                config.warm_dir,
+                max_bytes=config.warm_max_bytes,
+                log=self._log,
+            )
+            for reason in self.warm_store.sweep():
+                self.tel.emit(
+                    "warm", phase="sweep", mode="cold",
+                    reason="quarantined", detail=reason[:200],
+                )
         # idempotent resubmit: (tenant, submit_id) -> job_id, rebuilt
         # on recover, pruned with the retention cap
         self._submit_index: Dict[Tuple[str, str], str] = {}
@@ -624,12 +654,15 @@ class Scheduler:
         submit_id: Optional[str] = None,
         mode: str = "check",
         sim: Optional[dict] = None,
+        warm: bool = True,
     ) -> Job:
         """Validate eagerly (bad specs/cfgs/invariants fail the submit,
         not the queue), deduplicate on the client's ``submit_id``
         (a retried submit never enqueues twice), run admission control
         (over-quota/over-capacity submits are REJECTED at the door —
-        :class:`admission.AdmissionError`), and enqueue."""
+        :class:`admission.AdmissionError`), plan warm reuse
+        (``warm=False`` = the --no-warm opt-out: never reuse, never
+        harvest), and enqueue."""
         from pulsar_tlaplus_tpu.utils import cfg as cfgmod
 
         cfg_path = os.path.abspath(cfg_path)
@@ -673,6 +706,60 @@ class Scheduler:
                 raise ValueError(
                     f"unknown sim knob(s): {sorted(sim)}"
                 )
+        # sim jobs price at their ACTUAL swarm budget, check jobs at
+        # max_states (admission.state_price — the r18 pricing fix)
+        asking = admmod.state_price(
+            max_states, mode, sim_norm, self.config.max_states
+        )
+        # admission gates BEFORE warm planning: planning builds (and
+        # permanently pools) a checker, and an over-quota tenant's
+        # submit spam must be shed at the door without paying — or
+        # caching — any of that.  The check re-runs under the enqueue
+        # cv below (the authoritative, race-free decision).
+        with self.cv:
+            if submit_id:
+                prev = self._submit_index.get((tenant, str(submit_id)))
+                if prev is not None and prev in self.jobs:
+                    self.admission.count_dedup(tenant)
+                    self.tel.emit(
+                        "admission", action="dedup", tenant=tenant,
+                        job_id=prev, submit_id=str(submit_id),
+                    )
+                    return self.jobs[prev]
+            self._admission_gate(tenant, asking, spec)
+        # warm reuse plan (r19): decided at submit so status/telemetry
+        # show the intention up front; the artifact is digest-VERIFIED
+        # at install (the first slice), where a failure demotes to
+        # cold with the verify's reason.  A planner error must never
+        # fail a submit — it falls back to an honest cold plan.
+        wplan = None
+        if mode == "check" and self.warm_store is not None and warm:
+            try:
+                _k, ck = self.pool.get(
+                    spec, tlc_cfg, invs, max_states
+                )
+                wplan = warm_plan.plan(
+                    self.warm_store,
+                    spec=spec,
+                    constants=dict(tlc_cfg.constants),
+                    invariants=invs,
+                    config_sig=ck._config_sig(),
+                    module_digest=self._module_digest(spec),
+                    lsig=warm_plan.layout_sig(ck.model),
+                    n_initial=int(ck.model.n_initial),
+                    max_states=int(
+                        max_states or self.config.max_states
+                    ),
+                    check_deadlock=bool(ck.check_deadlock),
+                )
+            except Exception as e:  # noqa: BLE001 — plan must not
+                #                      fail an otherwise valid submit
+                self._log(f"warm: plan failed ({e!r:.160}) — cold")
+                wplan = warm_plan.WarmPlan(
+                    "cold", warm_plan.REASON_PLAN_ERROR
+                )
+        elif mode == "check" and self.warm_store is not None:
+            wplan = warm_plan.WarmPlan("cold", warm_plan.REASON_OPT_OUT)
         jid = jobmod.new_job_id()
         now = time.time()
         with self.cv:
@@ -688,17 +775,7 @@ class Scheduler:
                         job_id=prev, submit_id=str(submit_id),
                     )
                     return self.jobs[prev]
-            try:
-                self.admission.check(
-                    tenant, max_states, list(self.jobs.values())
-                )
-            except admmod.AdmissionError as e:
-                self.tel.emit(
-                    "admission",
-                    action="shed" if e.code == "capacity" else "reject",
-                    tenant=tenant, reason=e.reason, spec=spec,
-                )
-                raise
+            self._admission_gate(tenant, asking, spec)
             jdir = os.path.join(self.config.jobs_dir, jid)
             os.makedirs(jdir, exist_ok=True)
             job = Job(
@@ -721,6 +798,15 @@ class Scheduler:
                 submit_id=str(submit_id) if submit_id else None,
                 mode=mode,
                 sim=sim_norm,
+                warm=bool(warm),
+                warm_mode=wplan.mode if wplan else None,
+                warm_reason=wplan.reason if wplan else None,
+                warm_artifact=wplan.artifact if wplan else None,
+                warm_widened=(
+                    {k: list(v) for k, v in wplan.widened.items()}
+                    if wplan and wplan.widened
+                    else None
+                ),
             )
             self.admission.count_admit(tenant)
             self.jobs[jid] = job
@@ -752,11 +838,51 @@ class Scheduler:
         self.tel.emit(
             "admission", action="admit", tenant=tenant, job_id=jid,
         )
+        if wplan is not None:
+            # the plan decision, machine-readable (v12 `warm` event);
+            # cold plans COUNT here — they will never reach install
+            self.tel.emit(
+                "warm", phase="plan", job_id=jid, spec=spec,
+                mode=wplan.mode, reason=wplan.reason,
+                **(
+                    {"artifact": os.path.basename(wplan.artifact)}
+                    if wplan.artifact
+                    else {}
+                ),
+            )
+            if wplan.mode == "cold":
+                self._count_warm("cold", wplan.reason)
         self._log(
             f"job {jid}: submitted ({spec} @ {cfg_path}, "
-            f"tenant={tenant}, prio={priority})"
+            f"tenant={tenant}, prio={priority}"
+            + (
+                f", warm={wplan.mode}:{wplan.reason}"
+                if wplan is not None
+                else ""
+            )
+            + ")"
         )
         return job
+
+    def _admission_gate(
+        self, tenant: str, asking: int, spec: str
+    ) -> None:
+        """Quota check + the typed telemetry record on rejection
+        (caller holds the cv).  Runs twice per submit — once before
+        warm planning (the cheap door) and once under the enqueue cv
+        (the authoritative decision); a submit rejects at most once,
+        so the counters/events never double."""
+        try:
+            self.admission.check(
+                tenant, asking, list(self.jobs.values())
+            )
+        except admmod.AdmissionError as e:
+            self.tel.emit(
+                "admission",
+                action="shed" if e.code == "capacity" else "reject",
+                tenant=tenant, reason=e.reason, spec=spec,
+            )
+            raise
 
     def cancel(self, job_id: str) -> Job:
         with self.cv:
@@ -956,6 +1082,186 @@ class Scheduler:
             " states banked)"
         )
 
+    # ------------------------------------------------------ warm layer
+
+    def _module_digest(self, spec: str) -> str:
+        d = self._mod_digests.get(spec)
+        if d is None:
+            from pulsar_tlaplus_tpu.models import registry
+
+            d = registry.module_digest(spec)
+            self._mod_digests[spec] = d
+        return d
+
+    def _count_warm(self, mode: str, reason: str) -> None:
+        with self._warm_lock:
+            key = (mode, reason)
+            self.warm_counts[key] = self.warm_counts.get(key, 0) + 1
+
+    def _warm_install(self, job: Job, ck):
+        """Verify + install the planned artifact at the job's first
+        slice.  ``continue``: the artifact frame (and spill dir)
+        becomes the job's own frame — the slice resumes it.
+        ``reseed``: returns the engine seed built from the verified
+        artifact.  ANY failure — digest mismatch (``corrupt@warm``),
+        torn manifest, signature disagreement, a build error —
+        demotes the job to a cold run with a typed reason: *never a
+        wrong verdict*, and the unverifiable artifact is
+        quarantined."""
+        store = self.warm_store
+        mode = job.warm_mode
+        adir = job.warm_artifact
+
+        def demote(reason: str):
+            job.warm_mode = "cold"
+            job.warm_reason = reason
+            job.warm_artifact = None
+            self._count_warm("cold", reason)
+            self.tel.emit(
+                "warm", phase="install", job_id=job.job_id,
+                mode="cold", reason=reason,
+            )
+            self._log(
+                f"job {job.job_id}: warm {mode} demoted to cold "
+                f"({reason}) — full recheck"
+            )
+            return None
+
+        if store is None or not adir or not os.path.isdir(adir):
+            return demote(warm_plan.REASON_NO_ARTIFACT)
+        ok, why = store.verify(adir)
+        if not ok:
+            store.quarantine(adir, why)
+            return demote(why.split(":", 1)[0])
+        seed = None
+        try:
+            man = store.load_manifest(adir)
+            # the producing run's own trace-depth allowance: an
+            # artifact harvested from a RESEEDED run carries merged
+            # level_sizes, so the deficit compounds across
+            # generations and must ride the manifest
+            extra = int(man.get("extra_trace_depth") or 0)
+            if mode == "continue":
+                # authoritative gates: the engine's OWN frame
+                # signature must agree byte-for-byte, and the model
+                # SOURCE digest must be current (the sig identifies
+                # the model by name + bindings, not by source — a
+                # re-guarded action keeps the sig)
+                if man.get("config_sig") != ck._config_sig():
+                    return demote(warm_plan.REASON_ENGINE_CONFIG)
+                if man.get("module_digest") != self._module_digest(
+                    job.spec
+                ):
+                    return demote(warm_plan.REASON_MODULE_EDIT)
+                shutil.copyfile(
+                    os.path.join(adir, warm_store.FRAME),
+                    job.frame_path,
+                )
+                spill_src = os.path.join(
+                    adir, f"{warm_store.FRAME}.spill"
+                )
+                if os.path.isdir(spill_src):
+                    dst = f"{job.frame_path}.spill"
+                    shutil.rmtree(dst, ignore_errors=True)
+                    shutil.copytree(spill_src, dst)
+                job.warm_seed_levels = extra
+                info = {
+                    "states": int(man.get("distinct_states") or 0),
+                }
+            else:
+                widened = {
+                    k: (int(v[0]), int(v[1]))
+                    for k, v in (job.warm_widened or {}).items()
+                }
+                seed, info = warm_plan.build_reseed_seed(
+                    adir, man, ck.model, widened
+                )
+                # the merged seed levels no longer bound chain depth:
+                # allow trace walks the artifact's original levels
+                # (plus ITS producer's allowance) on top
+                job.warm_seed_levels = (
+                    int(man.get("levels") or 0) + extra
+                )
+        except Exception as e:  # noqa: BLE001 — a broken artifact
+            #                      must never fail the job
+            self._log(f"warm: install error ({e!r:.200})")
+            return demote(warm_plan.REASON_INSTALL)
+        self._count_warm(mode, job.warm_reason or "ok")
+        self.tel.emit(
+            "warm", phase="install", job_id=job.job_id, mode=mode,
+            reason=job.warm_reason or "ok",
+            artifact=os.path.basename(adir), **info,
+        )
+        self._log(
+            f"job {job.job_id}: warm {mode} installed "
+            f"({job.warm_reason}; {info})"
+        )
+        return seed
+
+    def _warm_harvest(self, job: Job, ck) -> None:
+        """Persist the finished run's frame as the warm artifact for
+        its config signature.  Completed clean runs frame via the
+        engine's ``final_frame``; truncated runs already left their
+        budget-stop frame.  Harvest failures are logged and ignored —
+        the job's result is already safe."""
+        if (
+            self.warm_store is None
+            or ck is None
+            or not job.warm
+            or job.mode != "check"
+            or not job.result
+        ):
+            return
+        if job.result.get("status") not in ("ok", "truncated"):
+            return
+        if job.result.get("stop_reason") in ("deadline", "cancelled"):
+            return
+        if not os.path.exists(job.frame_path):
+            return
+        try:
+            from pulsar_tlaplus_tpu.utils import cfg as cfgmod
+
+            tlc_cfg = cfgmod.load(job.cfg_path)
+            man = warm_plan.manifest_for(
+                job.spec,
+                dict(tlc_cfg.constants),
+                tuple(job.invariants or ()),
+                ck,
+                {
+                    "distinct_states": int(
+                        job.result.get("distinct_states") or 0
+                    ),
+                    "levels": len(
+                        job.result.get("level_sizes") or []
+                    ),
+                    "truncated": bool(job.result.get("truncated")),
+                    "stop_reason": job.result.get("stop_reason"),
+                    "job_id": job.job_id,
+                    "warm": job.warm_mode,
+                    # a reseeded run's frame has MERGED level_sizes:
+                    # consumers of this artifact need the same
+                    # parent-chain depth allowance this run ran with
+                    "extra_trace_depth": int(
+                        job.warm_seed_levels or 0
+                    ),
+                },
+            )
+            adir = self.warm_store.save(job.frame_path, man)
+        except Exception as e:  # noqa: BLE001
+            self._log(f"warm: harvest failed ({e!r:.200})")
+            return
+        if adir:
+            self.tel.emit(
+                "warm", phase="harvest", job_id=job.job_id,
+                mode=job.warm_mode or "cold", reason="harvested",
+                artifact=os.path.basename(adir),
+                states=int(job.result.get("distinct_states") or 0),
+            )
+            self._log(
+                f"job {job.job_id}: warm artifact saved "
+                f"({os.path.basename(adir)})"
+            )
+
     def _mk_hook(
         self, job: Job, deadline: Optional[float],
         resume: bool = False, ck=None,
@@ -1051,11 +1357,23 @@ class Scheduler:
             #                      take the scheduler thread down
             self._fail(job, e)
             return
+        # warm install (r19): on the job's FIRST slice (no frame yet),
+        # a planned continue copies the verified artifact frame into
+        # the job dir (the resume below picks it up) and a planned
+        # reseed builds the engine seed; any verification failure
+        # demotes to a cold run
+        warm_seed = None
+        if (
+            job.warm_mode in ("continue", "reseed")
+            and not os.path.exists(job.frame_path)
+        ):
+            warm_seed = self._warm_install(job, ck)
+        resume = os.path.exists(job.frame_path)
         remaining = None
         if job.time_budget_s is not None:
             remaining = job.time_budget_s - job.wall_s
             if remaining <= 0:
-                self._complete(job, None, budget_exhausted=True)
+                self._complete(job, None, budget_exhausted=True, ck=ck)
                 return
         if not resume:
             # fresh slices announce up front; RESUMED slices announce
@@ -1080,6 +1398,17 @@ class Scheduler:
         # tenant identity on every slice's engine run header (schema
         # v10 run_header.tenant — per-tenant attribution end to end)
         ck.tenant = job.tenant
+        # warm attribution (schema v12 run_header.warm) + the final
+        # frame a clean completion leaves as its reseed artifact
+        ck.warm = (
+            job.warm_mode
+            if job.warm_mode in ("continue", "reseed")
+            else None
+        )
+        ck.final_frame = bool(
+            self.warm_store is not None and job.warm
+        )
+        ck.extra_trace_depth = int(job.warm_seed_levels or 0)
         prev_wall = float(job.wall_s)
         hook = self._mk_hook(
             job, time.monotonic() + self.config.slice_s,
@@ -1088,12 +1417,17 @@ class Scheduler:
         ck.suspend_hook = hook
         self._active_ck = ck
         try:
-            r = ck.run(resume=resume)
+            r = ck.run(seed=warm_seed, resume=resume)
         except Exception as e:  # noqa: BLE001
             self._fail(job, e)
             return
         finally:
             ck.suspend_hook = None
+            # the pooled checker is shared: per-slice warm state must
+            # not leak into another job's (or a solo) run on it
+            ck.warm = None
+            ck.final_frame = False
+            ck.extra_trace_depth = 0
             self._active_ck = None
             # the metrics verb answers from this after the slice ends —
             # plain host dict copies, no device access
@@ -1178,7 +1512,7 @@ class Scheduler:
                 self._finish(job, jobmod.CANCELLED)
             self.persist()
             return
-        self._complete(job, r)
+        self._complete(job, r, ck=ck)
 
     def _run_sim_slice(self, job: Job) -> None:
         """One scheduling slice of a SIMULATION job (r18): the walker
@@ -1420,7 +1754,9 @@ class Scheduler:
             "run_ids": list(job.run_ids),
         }
 
-    def _complete(self, job: Job, r, budget_exhausted: bool = False):
+    def _complete(
+        self, job: Job, r, budget_exhausted: bool = False, ck=None
+    ):
         if budget_exhausted:
             # no fresh CheckerResult — the budget died between slices;
             # report the last suspended slice's progress, not nothing
@@ -1436,6 +1772,11 @@ class Scheduler:
             }
         else:
             job.result = self.result_record(job, r)
+        if job.warm_mode is not None:
+            # the reuse decision rides the durable result record too
+            # (docs/incremental.md: mode + reason on the job record)
+            job.result.setdefault("warm", job.warm_mode)
+            job.result.setdefault("warm_reason", job.warm_reason)
         err = _write_json_atomic(job.result_path, job.result)
         if err is not None:
             # disk-full on the result artifact: the completion stands
@@ -1444,6 +1785,9 @@ class Scheduler:
                 f"job {job.job_id}: result.json write FAILED "
                 f"({err!r:.120}); table record stands"
             )
+        # harvest BEFORE _finish removes the terminal job's frame —
+        # this frame (budget-stop or final_frame) IS the artifact
+        self._warm_harvest(job, ck)
         with self.cv:
             self._finish(job, jobmod.DONE)
         self.persist()

@@ -471,6 +471,8 @@ class ServiceDaemon:
             time_budget_s=req.get("time_budget_s"),
             mode=mode,
             sim=sim,
+            # warm reuse opt-out (r19): absent = opted in
+            warm=bool(req.get("warm", True)),
             tenant=req["_tenant"],
             priority=max(
                 protocol.PRIORITY_MIN,
@@ -487,6 +489,15 @@ class ServiceDaemon:
             {
                 "ok": True, "job_id": job.job_id, "state": job.state,
                 "tenant": job.tenant,
+                # the reuse plan, so `submit` can print it up front
+                **(
+                    {
+                        "warm_mode": job.warm_mode,
+                        "warm_reason": job.warm_reason,
+                    }
+                    if job.warm_mode is not None
+                    else {}
+                ),
             },
         )
 

@@ -645,6 +645,7 @@ class StreamingSimulator:
             profile_sig=self.profile_sig,
             hbm_budget=None,
             tenant=self.tenant,
+            warm=getattr(self, "warm", None),
             wall_unix=round(time.time(), 3),
             n_walkers=self.B,
             depth=self.T,

@@ -22,6 +22,11 @@ variable into synthetic faults fired at named host-side sites:
                                        a synthetic ENOSPC
     PTT_FAULT=enospc@spill:1           spill write 1 fails with ENOSPC
                                        (tiered-store degradation drill)
+    PTT_FAULT=corrupt@warm:1           warm-artifact verification 1
+                                       computes a corrupted digest
+                                       (cold-fallback drill, r19)
+    PTT_FAULT=torn@warmwrite:2         warm-artifact write 2 publishes
+                                       half a manifest (quarantine drill)
     PTT_FAULT=oom@level:7,kill@level:9 comma-separated specs compose
 
 Syntax: ``kind@site:count`` — ``site`` is a counter the engines
@@ -32,7 +37,9 @@ number, ``sweep`` = the liveness engine's edge-sweep chunk,
 round 17 the SERVICE layer counts too: ``conn`` = the daemon's
 accepted-connection sequence, ``line`` = the daemon's sent-protocol-
 line sequence, ``persist`` = the scheduler's queue.json snapshot
-sequence, ``spill`` = the tiered store's spill-write sequence),
+sequence, ``spill`` = the tiered store's spill-write sequence,
+``warm`` = the warm store's artifact-verification sequence and
+``warmwrite`` its artifact-write sequence — r19),
 ``count`` the value at which the spec fires.  Each spec fires AT MOST ONCE per process: a run that recovers
 from an injected OOM and re-expands the same level must not be
 re-injected forever (mirroring the real world, where the recovery's
@@ -78,6 +85,13 @@ KINDS = (
     # daemon closes the connection (`drop`), tears a protocol line
     # (`torn`), or raises :func:`enospc_error` (`enospc`)
     "drop", "torn", "enospc",
+    # warm-artifact kinds (r19, warm/store.py): `corrupt@warm:N`
+    # makes the N-th artifact digest VERIFICATION compute a corrupted
+    # digest (the bit-flip-on-disk path); `torn@warmwrite:N` /
+    # `kill@warmwrite:N` fire inside the N-th artifact WRITE (torn
+    # publishes half a manifest; kill dies between frame and
+    # manifest — the startup-sweep quarantine drill)
+    "corrupt",
 )
 
 # parse cache keyed on the raw env value + set of fired spec indexes

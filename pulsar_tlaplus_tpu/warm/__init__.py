@@ -1,0 +1,18 @@
+"""Incremental checking: digest-verified warm-start artifacts + the
+reuse planner (docs/incremental.md).
+
+``store`` persists one warm artifact per engine config signature —
+the run's checkpoint frame (packed fpset key planes, frontier frame,
+level cursor, rows/logs) plus a SHA-256 manifest binding it to the
+full semantic signature — under the daemon's state dir, with the
+r7-style robustness discipline: per-writer-unique tmp + ``os.replace``
+writes, content digests verified on every read, a startup sweep that
+quarantines unverifiable artifacts, and an LRU byte cap.
+
+``plan`` decides, per incoming submit, whether the stored artifact can
+be reused **soundly**: ``continue`` (identical signature, widened
+budget — resume the frame), ``reseed`` (constant widening on a
+declared-monotone axis — old fingerprint set stays visited, the
+saturated suffix replays), or ``cold`` (anything else — a full recheck
+with a typed reason, never a wrong verdict).
+"""

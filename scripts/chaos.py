@@ -13,9 +13,17 @@ faults —
     torn@line:N      the daemon's N-th sent protocol line is torn
     enospc@persist:N queue.json snapshot N hits a synthetic disk-full
 
+    corrupt@warm:N   the N-th warm-artifact digest verification
+                     computes a corrupted digest (r19 — the
+                     incremental-checking layer's fallback drill)
+
 — while concurrent clients submit jobs over TCP with bearer tokens,
 retrying through the chaos with backoff + jitter and idempotent
-``submit_id`` dedup.  The drill PASSES iff:
+``submit_id`` dedup.  The r19 warm phase additionally submits a
+TRUNCATED job, then resubmits it at a widened budget with the warm
+cache's next verification corrupted: the job must fall back COLD with
+a typed reason (``digest_mismatch``), quarantine the artifact, and
+STILL land the solo-exact result.  The drill PASSES iff:
 
 - every ADMITTED job completes with state-for-state solo parity
   (distinct states, diameter, level sizes, verdict, violation gid,
@@ -287,10 +295,13 @@ def run_chaos(
         max_burst = max(quota_burst, 8 * (tenant_max_queued + 1))
         for k in range(max_burst):
             try:
+                # warm=False: a warm-continue instant completion would
+                # drain the queue under the burst (the dedicated warm
+                # phase below is the warm layer's own drill)
                 beta_admitted.append(
                     beta.submit(
                         "compaction", comp_cfg,
-                        submit_id=f"beta-burst-{k}",
+                        submit_id=f"beta-burst-{k}", warm=False,
                     )
                 )
             except AdmissionRejected as e:
@@ -333,11 +344,13 @@ def run_chaos(
                         spec, cfg_path,
                         submit_id=f"c{ci}-j{k}",
                         priority=(ci + k) % 3,
+                        warm=False,
                     )
                     # the dedup pin: an immediate retried submit with
                     # the SAME submit_id must return the SAME job
                     again = cl.submit(
                         spec, cfg_path, submit_id=f"c{ci}-j{k}",
+                        warm=False,
                     )
                     if again != jid:
                         raise ChaosFailure(
@@ -380,6 +393,62 @@ def run_chaos(
                 )
             _assert_parity(r["result"], solos[name], f"{name}/{jid}")
             report["completed"] += 1
+
+        # --- warm reuse under corruption (r19) ----------------------
+        # a truncated job's resubmit at a widened budget is the warm
+        # layer's headline path; with the artifact verification
+        # corrupted it must fall back COLD (typed reason, quarantined
+        # artifact) and still land the solo-exact result
+        operator = ServiceClient(config.socket_path, timeout=timeout_s)
+        jt = operator.submit(
+            "compaction", comp_cfg, max_states=600,
+            submit_id="warm-trunc",
+        )
+        rt = operator.wait(jt, timeout=timeout_s)
+        if (rt.get("result") or {}).get("status") != "truncated":
+            raise ChaosFailure(
+                f"truncation probe ended {rt.get('result')!r} "
+                "(wanted status=truncated)"
+            )
+        report["completed"] += 1  # completed as designed (truncated)
+        wstore = daemon.sched.warm_store
+        if wstore is None:
+            raise ChaosFailure("daemon has no warm store")
+        # arm the NEXT artifact verification to compute a corrupted
+        # digest (all other jobs are terminal here, so the next verify
+        # IS this resubmit's install)
+        os.environ["PTT_FAULT"] = (
+            os.environ.get("PTT_FAULT", "")
+            + f",corrupt@warm:{wstore._verify_n + 1}"
+        ).lstrip(",")
+        jw = operator.submit(
+            "compaction", comp_cfg, submit_id="warm-widened",
+        )
+        rw = operator.wait(jw, timeout=timeout_s)
+        if rw.get("state") != "done" or not rw.get("result"):
+            raise ChaosFailure(
+                f"widened resubmit ended {rw.get('state')}: "
+                f"{rw.get('error')}"
+            )
+        if rw["result"].get("warm") != "cold" or (
+            rw["result"].get("warm_reason") != "digest_mismatch"
+        ):
+            raise ChaosFailure(
+                "corrupted warm artifact was not demoted to a typed "
+                f"cold fallback (got warm={rw['result'].get('warm')!r}"
+                f" reason={rw['result'].get('warm_reason')!r})"
+            )
+        _assert_parity(
+            rw["result"], solos["compaction"], f"warm-cold/{jw}"
+        )
+        report["completed"] += 1
+        report["admitted"] += [("compaction", jt), ("compaction", jw)]
+        qdir = wstore.quarantine_dir
+        if not os.path.isdir(qdir) or not os.listdir(qdir):
+            raise ChaosFailure(
+                "corrupted artifact was not quarantined"
+            )
+        report["warm_quarantined"] = len(os.listdir(qdir))
 
         # --- rejections visible in ptt_admission_*, table honest ---
         metrics_text = waiter.metrics()

@@ -67,7 +67,11 @@ files (obs/ledger.py — record structure + digest integrity);
 format version, engine-known knobs, filename/sig agreement);
 ``--tokens`` validates daemon tokens.json files (service/auth.py —
 tokens_v, non-empty tenants, unique tokens/tenants, reserved-name
-and token-length rules).  Bench
+and token-length rules); ``--warm`` validates warm-artifact
+directories (warm/store.py — manifest shape, warm_v, per-file
+SHA-256 digests + byte counts; r19: v12 run headers carry ``warm``
+— the warm-start mode, null on cold/standalone runs — and the
+daemon emits ``warm`` reuse-decision events).  Bench
 rules: ``bench_schema`` >= 2 requires the
 headline keys, >= 3 additionally the telemetry/survivability key set
 (``fpset_*``, ``ckpt_*``, ``stop_reason``...), >= 4 additionally
@@ -424,6 +428,12 @@ def main(argv=None) -> int:
         help="treat the .json files as daemon tokens.json files "
         "(serve --tokens) and validate their shape (service/auth.py)",
     )
+    ap.add_argument(
+        "--warm", action="store_true",
+        help="treat the files as warm-artifact dirs (or their "
+        "manifest.json) and validate manifest shape + SHA-256 "
+        "digest integrity (warm/store.py, docs/incremental.md)",
+    )
     args = ap.parse_args(argv)
     files = list(args.files)
     if args.all_bench:
@@ -435,7 +445,11 @@ def main(argv=None) -> int:
         ap.error("nothing to validate (pass files or --all-bench)")
     errors: List[str] = []
     for p in files:
-        if p.endswith(".jsonl"):
+        if args.warm:
+            from pulsar_tlaplus_tpu.warm.store import validate_artifact
+
+            errors += validate_artifact(p)
+        elif p.endswith(".jsonl"):
             if args.ledger:
                 from pulsar_tlaplus_tpu.obs.ledger import (
                     validate_ledger,

@@ -20,14 +20,30 @@ interpreter's transition relation (every claimed action must be a
 real interpreter successor producing the same rendered state, and the
 invariant must hold until the final state).
 
+``--widen`` (round 19, incremental checking) switches to the WARM
+RESEED differential: per spec, sample a base binding, run it cold to
+completion, harvest a warm artifact (warm/store.py), then WIDEN one
+declared-monotone axis (models/registry.MONOTONE_AXES) and
+cross-check the warm-reseeded run against an independent cold run at
+the widened binding — clean runs must agree on the exact reachable
+STATE SET (sorted packed rows, not just counts), verdict runs must
+both find a verdict and the warm counterexample must replay through
+the interpreter.  A planner REFUSAL (e.g. the widening stepped the
+counter field's bitlen -> layout_change) is asserted to carry the
+right typed reason — the planner wrongly reseeding is a failure,
+the planner refusing soundly is not.
+
 Usage:
 
     python scripts/fuzz.py --seed 7 --per-spec 3            # sweep
     python scripts/fuzz.py --seed 0 --per-spec 1 --spec compaction
+    python scripts/fuzz.py --seed 0 --per-spec 5 --widen    # reseed
 
 Exit status: 0 = every binding agreed, 1 = mismatches (listed on
-stderr as JSON), 2 = usage.  The pinned-seed fast drill runs in
-tier-1 (tests/test_sim.py); the randomized sweep is slow-marked.
+stderr as JSON), 2 = usage.  The pinned-seed fast drills run in
+tier-1 (tests/test_sim.py, tests/test_warm.py); the randomized
+sweeps (``--per-spec 20`` and ``--per-spec 20 --widen``) are the
+scheduled slow soak lane (ROADMAP).
 """
 
 from __future__ import annotations
@@ -361,6 +377,276 @@ def run(
     return records, failures
 
 
+# --------------------------------------------- warm-reseed differential
+
+# cfg-CONSTANT field of each declared-monotone axis on the native
+# constants dataclasses (the registry axes name cfg constants; the
+# fuzz samplers build native objects)
+AXIS_FIELDS = {
+    ("compaction", "MaxCrashTimes"): "max_crash_times",
+    ("subscription", "MaxCrashTimes"): "max_crash_times",
+    ("bookkeeper", "MaxBookieCrashes"): "max_bookie_crashes",
+    ("georeplication", "MaxReplicatorCrashes"):
+        "max_replicator_crashes",
+}
+
+
+def _cfg_constants(spec: str, c) -> Dict[str, object]:
+    """Constants object -> the cfg-level CONSTANT bindings the warm
+    manifests carry (the registry's inverse mapping; compaction's
+    model-value sets included)."""
+    if spec == "compaction":
+        return {
+            "MessageSentLimit": c.message_sent_limit,
+            "CompactionTimesLimit": c.compaction_times_limit,
+            "KeySpace": frozenset(range(1, c.num_keys + 1)),
+            "ValueSpace": frozenset(range(1, c.num_values + 1)),
+            "RetainNullKey": c.retain_null_key,
+            "MaxCrashTimes": c.max_crash_times,
+            "ModelProducer": c.model_producer,
+            "ModelConsumer": c.model_consumer,
+        }
+    return _interp_constants(spec, c)
+
+
+def _rows_set(ck, n: int):
+    """The run's reachable state set as sorted packed rows (exact —
+    the warm-vs-cold clean-run equality is SET equality, not count
+    equality)."""
+    import numpy as np
+
+    W = int(ck.model.layout.W)
+    rows = np.asarray(ck.last_bufs["rows"])[: n * W].reshape(n, W)
+    order = np.lexsort(rows.T[::-1])
+    return rows[order]
+
+
+def widen_one(
+    spec: str, rng: random.Random, scratch: str
+) -> Dict[str, object]:
+    """One warm-reseed differential point: base cold run -> artifact
+    -> widened plan -> (reseeded run vs cold run) or an asserted
+    sound refusal."""
+    import numpy as np
+
+    from pulsar_tlaplus_tpu.engine.device_bfs import DeviceChecker
+    from pulsar_tlaplus_tpu.models import registry
+    from pulsar_tlaplus_tpu.warm import plan as warm_plan
+    from pulsar_tlaplus_tpu.warm import store as warm_store
+
+    axes = registry.MONOTONE_AXES.get(spec, ())
+    rec: Dict[str, object] = {"spec": spec, "mode": "widen"}
+    mism: List[str] = []
+    if not axes:
+        rec["skipped"] = "no declared monotone axis"
+        rec["mismatches"] = []
+        return rec
+    kw = dict(DEVICE_KW)
+    check_deadlock = spec != "compaction"
+    from pulsar_tlaplus_tpu.ops.packing import bitlen
+
+    for _attempt in range(50):
+        constants = sample_binding(spec, rng)
+        axis = axes[rng.randrange(len(axes))]
+        field = AXIS_FIELDS[(spec, axis.constant)]
+        old_val = int(getattr(constants, field))
+        # prefer a bitlen-preserving widening (it exercises the real
+        # reseed path); every ~4th point keeps a random delta so the
+        # sound-refusal branch (layout_change) stays covered too
+        deltas = [1, 2]
+        rng.shuffle(deltas)
+        if rng.random() < 0.75:
+            deltas.sort(
+                key=lambda dd: bitlen(old_val + dd) != bitlen(old_val)
+            )
+        new_val = old_val + deltas[0]
+        try:
+            constants.validate()
+            new_constants = dataclasses.replace(
+                constants, **{field: new_val}
+            )
+            new_constants.validate()
+        except (ValueError, TypeError):
+            continue
+        break
+    else:
+        rec["skipped"] = "no valid widening sampled"
+        rec["mismatches"] = []
+        return rec
+    rec["binding"] = dataclasses.asdict(constants)
+    rec["widened"] = {axis.constant: [old_val, new_val]}
+    model_old = _model_of(spec, constants)
+    model_new = _model_of(spec, new_constants)
+    invariants = tuple(model_old.default_invariants)
+    os.makedirs(scratch, exist_ok=True)
+    frame = os.path.join(scratch, "frame.npz")
+    ck_base = DeviceChecker(
+        model_old, invariants=invariants,
+        check_deadlock=check_deadlock, checkpoint_path=frame, **kw,
+    )
+    ck_base.final_frame = True
+    r_base = ck_base.run()
+    rec["base"] = {
+        "distinct_states": r_base.distinct_states,
+        "violation": r_base.violation,
+        "deadlock": bool(r_base.deadlock),
+    }
+    if r_base.violation or r_base.deadlock or r_base.truncated:
+        # the daemon only harvests clean/truncated-clean runs; a
+        # verdict at the base binding is not a reseed scenario
+        rec["skipped"] = "base run has a verdict"
+        rec["mismatches"] = []
+        return rec
+    store = warm_store.WarmStore(os.path.join(scratch, "warm"))
+    man = warm_plan.manifest_for(
+        spec, _cfg_constants(spec, constants), invariants, ck_base,
+        {
+            "distinct_states": int(r_base.distinct_states),
+            "levels": len(r_base.level_sizes),
+            "truncated": False,
+            "stop_reason": r_base.stop_reason,
+        },
+    )
+    if store.save(frame, man) is None:
+        rec["mismatches"] = ["artifact save failed"]
+        return rec
+    ck_new = DeviceChecker(
+        model_new, invariants=invariants,
+        check_deadlock=check_deadlock, **kw,
+    )
+    plan = warm_plan.plan(
+        store,
+        spec=spec,
+        constants=_cfg_constants(spec, new_constants),
+        invariants=invariants,
+        config_sig=ck_new._config_sig(),
+        module_digest=registry.module_digest(spec),
+        lsig=warm_plan.layout_sig(model_new),
+        n_initial=int(model_new.n_initial),
+        max_states=int(kw["max_states"]),
+        check_deadlock=check_deadlock,
+    )
+    rec["plan"] = {"mode": plan.mode, "reason": plan.reason}
+    if plan.mode != "reseed":
+        # a refusal must be the SOUND one: the only legitimate cause
+        # of a refused pure-axis widening is a bitlen step on the
+        # counter field (layout_change)
+        from pulsar_tlaplus_tpu.ops.packing import bitlen
+
+        stepped = (
+            warm_plan.layout_sig(model_new)
+            != warm_plan.layout_sig(model_old)
+        )
+        if plan.mode == "cold" and stepped and (
+            plan.reason == warm_plan.REASON_LAYOUT_CHANGE
+        ):
+            rec["skipped"] = (
+                f"sound refusal: bitlen({old_val})="
+                f"{bitlen(old_val)} -> bitlen({new_val})="
+                f"{bitlen(new_val)}"
+            )
+        else:
+            mism.append(
+                f"planner refused a valid widening: {plan.mode}/"
+                f"{plan.reason} (layout stepped: {stepped})"
+            )
+        rec["mismatches"] = mism
+        return rec
+    ok, why = store.verify(plan.artifact)
+    if not ok:
+        rec["mismatches"] = [f"artifact failed verify: {why}"]
+        return rec
+    seed, info = warm_plan.build_reseed_seed(
+        plan.artifact, plan.manifest, model_new, plan.widened
+    )
+    rec["reseed"] = info
+    # merged seed levels no longer bound the parent-chain depth
+    ck_new.extra_trace_depth = len(r_base.level_sizes)
+    r_warm = ck_new.run(seed=seed)
+    ck_cold = DeviceChecker(
+        model_new, invariants=invariants,
+        check_deadlock=check_deadlock, **kw,
+    )
+    r_cold = ck_cold.run()
+    rec["warm"] = {
+        "distinct_states": r_warm.distinct_states,
+        "violation": r_warm.violation,
+        "deadlock": bool(r_warm.deadlock),
+    }
+    rec["cold"] = {
+        "distinct_states": r_cold.distinct_states,
+        "violation": r_cold.violation,
+        "deadlock": bool(r_cold.deadlock),
+    }
+    warm_verdict = bool(r_warm.violation or r_warm.deadlock)
+    cold_verdict = bool(r_cold.violation or r_cold.deadlock)
+    if warm_verdict != cold_verdict:
+        mism.append(
+            f"verdict class: warm={r_warm.violation or r_warm.deadlock}"
+            f" cold={r_cold.violation or r_cold.deadlock}"
+        )
+    elif not cold_verdict:
+        # clean runs: the reachable SETS must be identical
+        if r_warm.distinct_states != r_cold.distinct_states:
+            mism.append(
+                f"distinct_states: warm={r_warm.distinct_states} "
+                f"cold={r_cold.distinct_states}"
+            )
+        else:
+            sw = _rows_set(ck_new, r_warm.distinct_states)
+            sc = _rows_set(ck_cold, r_cold.distinct_states)
+            if not np.array_equal(sw, sc):
+                mism.append("reachable state SETS differ")
+    elif r_warm.violation and r_warm.trace is not None:
+        # the warm counterexample must be REAL: replay it through the
+        # independent interpreter at the widened binding
+        _ri, replay = interp_result(spec, new_constants, invariants)
+        err = replay(
+            r_warm.trace, r_warm.trace_actions, r_warm.violation
+        )
+        if err:
+            mism.append(f"warm trace replay: {err}")
+    rec["mismatches"] = mism
+    return rec
+
+
+def run_widen(
+    seed: int,
+    per_spec: int,
+    specs: Tuple[str, ...] = SPECS,
+    log=None,
+) -> Tuple[List[Dict], List[Dict]]:
+    """The --widen sweep: ``per_spec`` reseed differentials per spec
+    from one seeded RNG (replayable from --seed)."""
+    import tempfile
+
+    _log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+    rng = random.Random(seed)
+    records: List[Dict] = []
+    for spec in specs:
+        for k in range(per_spec):
+            scratch = tempfile.mkdtemp(prefix=f"ptt_widen_{spec}_")
+            rec = widen_one(spec, rng, scratch)
+            records.append(rec)
+            _log(
+                f"widen {spec} #{k + 1}: "
+                + (
+                    f"skipped ({rec['skipped']})"
+                    if rec.get("skipped")
+                    else f"{rec.get('plan', {}).get('mode')} "
+                    f"warm={rec.get('warm', {}).get('distinct_states')}"
+                    f" cold={rec.get('cold', {}).get('distinct_states')}"
+                )
+                + (
+                    f"  MISMATCH: {rec['mismatches']}"
+                    if rec["mismatches"]
+                    else ""
+                )
+            )
+    failures = [r for r in records if r["mismatches"]]
+    return records, failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="differential fuzz: randomized constant bindings, "
@@ -379,12 +665,19 @@ def main(argv=None) -> int:
         "--json", action="store_true",
         help="print every record as JSON on stdout",
     )
+    ap.add_argument(
+        "--widen", action="store_true",
+        help="warm-reseed differential: randomized constant WIDENINGS "
+        "on the declared-monotone axes, warm-vs-cold state-set "
+        "equality (docs/incremental.md)",
+    )
     args = ap.parse_args(argv)
     specs = tuple(args.spec) if args.spec else SPECS
     unknown = [s for s in specs if s not in SPECS]
     if unknown:
         ap.error(f"unknown spec(s) {unknown} (known: {SPECS})")
-    records, failures = run(args.seed, args.per_spec, specs)
+    sweep = run_widen if args.widen else run
+    records, failures = sweep(args.seed, args.per_spec, specs)
     if args.json:
         print(json.dumps(records, default=str))
     for f in failures:
