@@ -270,10 +270,11 @@ def load_metrics_records(metrics_path):
 
 
 def artifact_skeleton() -> dict:
-    """Every bench_schema-9 required key, None-filled — the simulate
-    and matrix paths fill what applies and stay validator-clean
-    (scripts/check_telemetry_schema.py BENCH_KEYS_V9: keys are
-    REQUIRED, values may be null where the mode has no measurement)."""
+    """Every bench_schema-10 required key, None-filled — the
+    simulate, matrix, and fleet paths fill what applies and stay
+    validator-clean (scripts/check_telemetry_schema.py
+    BENCH_KEYS_V10: keys are REQUIRED, values may be null where the
+    mode has no measurement)."""
     keys = (
         "metric", "value", "unit", "vs_baseline",
         "vs_baseline_definition", "distinct_states", "levels",
@@ -287,9 +288,12 @@ def artifact_skeleton() -> dict:
         "work_compact_elems", "work_append_rows", "work_groups",
         "hbm_budget", "spill_bytes_per_state", "spill_overlap_ratio",
         "walks_per_sec", "steps_per_state",
+        # fleet keys (r20, bench_schema 10): null on non-fleet runs
+        "fleet_backends", "fleet_jobs_per_sec", "fleet_route_ms",
+        "fleet_replicated_wire_bytes",
     )
     d = {k: None for k in keys}
-    d["bench_schema"] = 9
+    d["bench_schema"] = 10
     return d
 
 
@@ -561,7 +565,184 @@ def run_matrix(args) -> None:
             f"{args.matrix_ledger}",
             file=sys.stderr,
         )
-    print(json.dumps({"matrix": results, "bench_schema": 9}))
+    print(json.dumps({"matrix": results, "bench_schema": 10}))
+
+
+# -------------------------------------------------------------- fleet
+
+# the fleet bench workload: the small compaction binding (1,654
+# states) at the service-test geometry — small enough that an N-way
+# batch exhausts on the CPU mesh in seconds, real enough that the
+# dispatcher's routing, stickiness, and replication all fire
+FLEET_BENCH_CFG = """
+CONSTANTS
+    MessageSentLimit = 2
+    CompactionTimesLimit = 2
+    ModelConsumer = FALSE
+    ConsumeTimesLimit = 2
+    KeySpace = {1}
+    ValueSpace = {1}
+    RetainNullKey = TRUE
+    MaxCrashTimes = 1
+    ModelProducer = TRUE
+SPECIFICATION Spec
+INVARIANTS
+"""
+
+FLEET_BENCH_GEOM = dict(
+    sub_batch=64,
+    visited_cap=1 << 10,
+    frontier_cap=1 << 8,
+    max_states=1 << 20,
+    checkpoint_every=1,
+)
+
+
+def run_fleet_bench(args) -> None:
+    """``--fleet N``: spin N local ``serve`` backends plus one
+    dispatcher in-process (unix sockets under a scratch dir), push a
+    replication probe and a mixed batch through the single endpoint,
+    and emit ONE bench_schema-10 JSON line with the fleet keys —
+    queue throughput (fleet_jobs_per_sec), mean route latency
+    (fleet_route_ms), and sieve replication economy
+    (fleet_replicated_wire_bytes) — ingestible by ``cli.py ledger
+    add`` and gateable by ``ledger gate`` (docs/fleet.md)."""
+    import shutil
+    import tempfile
+
+    from pulsar_tlaplus_tpu.fleet.dispatcher import (
+        FleetConfig,
+        FleetDispatcher,
+    )
+    from pulsar_tlaplus_tpu.service.client import ServiceClient
+    from pulsar_tlaplus_tpu.service.scheduler import (
+        CheckerPool,
+        ServiceConfig,
+    )
+    from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+
+    n = int(args.fleet)
+    if n < 1:
+        sys.exit("bench: --fleet needs N >= 1 backends")
+    root = tempfile.mkdtemp(prefix="ptt_fleet_bench_")
+    cfg_path = os.path.join(root, "small_compaction.cfg")
+    with open(cfg_path, "w") as f:
+        f.write(FLEET_BENCH_CFG)
+    daemons, disp = [], None
+    try:
+        configs = [
+            ServiceConfig(
+                state_dir=os.path.join(root, f"b{i}"),
+                slice_s=0.3,
+                **FLEET_BENCH_GEOM,
+            )
+            for i in range(n)
+        ]
+        # prewarm every backend OUTSIDE the timed window: the bench
+        # measures the fleet's routing + queue economy, not N cold
+        # compiles of the same program
+        t_compile = time.time()
+        for i, c in enumerate(configs):
+            pool = CheckerPool(c)
+            pool.warm("compaction", cfg_path)
+            daemons.append(ServiceDaemon(c, pool=pool))
+            daemons[-1].start()
+            print(
+                f"fleet bench: backend {i} warmed "
+                f"({time.time() - t_compile:.1f}s cumulative)",
+                file=sys.stderr,
+            )
+        compile_s = time.time() - t_compile
+        disp = FleetDispatcher(FleetConfig(
+            state_dir=os.path.join(root, "dispatch"),
+            backends=tuple(c.socket_path for c in configs),
+            health_interval_s=0.2,
+            sticky_s=0.0,  # load shape: spread by live signal
+        ))
+        disp.start()
+        cl = ServiceClient(disp.config.socket_path, timeout=240.0)
+
+        # replication probe: a truncated run's artifact must cross
+        # the fleet (the wire-byte economy the artifact records)
+        repl_bytes = 0
+        if n > 1:
+            probe = cl.submit(
+                "compaction", cfg_path, invariants=[],
+                max_states=600, submit_id="fleet-bench-probe",
+            )
+            cl.wait(probe, timeout=float(args.budget_s) * 10 + 300)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                snap = disp.metrics_snapshot()
+                repl_bytes = int(sum(snap["repl_bytes"].values()))
+                if repl_bytes:
+                    break
+                time.sleep(0.1)
+            print(
+                f"fleet bench: replication probe shipped "
+                f"{repl_bytes} wire bytes",
+                file=sys.stderr,
+            )
+
+        # the timed batch: 2 jobs per backend through ONE endpoint
+        n_jobs = 2 * n
+        t0 = time.monotonic()
+        jids = [
+            cl.submit("compaction", cfg_path, invariants=[])
+            for _ in range(n_jobs)
+        ]
+        states = None
+        for jid in jids:
+            r = cl.wait(jid, timeout=float(args.budget_s) * 10 + 600)
+            if r["state"] != "done" or r["result"]["status"] not in (
+                "ok", "violation"
+            ):
+                sys.exit(
+                    f"bench: fleet job {jid} ended "
+                    f"{r['state']}/{(r.get('result') or {}).get('status')}"
+                )
+            states = r["result"]["distinct_states"]
+        elapsed = time.monotonic() - t0
+        snap = disp.metrics_snapshot()
+        routes = sum(snap["routes"].values())
+        route_ms = 1e3 * float(snap["route_s"]) / max(routes, 1)
+        jobs_per_sec = n_jobs / max(elapsed, 1e-9)
+        print(
+            f"fleet bench: {n_jobs} jobs over {n} backend(s) in "
+            f"{elapsed:.1f}s ({jobs_per_sec:.2f} jobs/s, "
+            f"{route_ms:.1f} ms/route)",
+            file=sys.stderr,
+        )
+    finally:
+        if disp is not None:
+            disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+    d = artifact_skeleton()
+    d.update(
+        metric=f"fleet queue throughput: {n_jobs} small-compaction "
+        f"jobs through one dispatcher over {n} backend(s) "
+        "(routing + slicing + warm replication included)",
+        value=round(jobs_per_sec, 3),
+        unit="jobs/sec",
+        mode="fleet",
+        engine="fleet r20 (dispatcher + N serve backends, unix "
+        "sockets, sieve replication)",
+        vs_baseline_definition="none (fleet has no native baseline; "
+        "fleet_jobs_per_sec is the headline)",
+        compile_warmup_s=round(compile_s, 1),
+        stop_reason="done",
+        truncated=False,
+        distinct_states=states,
+        max_states=FLEET_BENCH_GEOM["max_states"],
+        fleet_backends=n,
+        fleet_jobs_per_sec=round(jobs_per_sec, 3),
+        fleet_route_ms=round(route_ms, 3),
+        fleet_replicated_wire_bytes=repl_bytes,
+    )
+    print(json.dumps(d))
 
 
 def parse_args(argv=None):
@@ -595,6 +776,13 @@ def parse_args(argv=None):
         "--sim-steps", dest="sim_steps", type=int, default=None,
         help="with --mode simulate: total step budget (overrides the "
         "time budget — the deterministic bench shape)",
+    )
+    ap.add_argument(
+        "--fleet", type=int, default=None, metavar="N",
+        help="fleet bench: spin N local serve backends + one "
+        "dispatcher in-process and measure queue throughput / route "
+        "latency / replication wire bytes through the single "
+        "endpoint (bench_schema-10 fleet_* keys; docs/fleet.md)",
     )
     ap.add_argument(
         "--matrix", action="store_true",
@@ -737,6 +925,8 @@ def main(argv=None):
     import jax
 
     args = parse_args(argv)
+    if args.fleet:
+        return run_fleet_bench(args)
     if args.matrix:
         return run_matrix(args)
     if args.mode == "simulate":
@@ -1014,11 +1204,18 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # spill_overlap_ratio — null on untiered runs);
                 # schema 9 (r18) adds the workload mode plus the
                 # swarm-simulation throughput keys (walks_per_sec,
-                # steps_per_state — null on check-mode runs)
-                "bench_schema": 9,
+                # steps_per_state — null on check-mode runs);
+                # schema 10 (r20) adds the fleet-dispatcher keys
+                # (fleet_backends, fleet_jobs_per_sec, fleet_route_ms,
+                # fleet_replicated_wire_bytes — null on solo runs)
+                "bench_schema": 10,
                 "mode": "check",
                 "walks_per_sec": None,
                 "steps_per_state": None,
+                "fleet_backends": None,
+                "fleet_jobs_per_sec": None,
+                "fleet_route_ms": None,
+                "fleet_replicated_wire_bytes": None,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2

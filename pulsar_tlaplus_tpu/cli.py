@@ -677,6 +677,7 @@ def _client_fail(op: str, e) -> None:
     from pulsar_tlaplus_tpu.service.client import (
         AdmissionRejected,
         AuthError,
+        BackendUnavailable,
     )
 
     if isinstance(e, AuthError):
@@ -687,12 +688,18 @@ def _client_fail(op: str, e) -> None:
             f"tpu-tlc: {op} rejected ({e.code}): {e}", file=sys.stderr
         )
         sys.exit(5)
+    if isinstance(e, BackendUnavailable):
+        # the fleet had no healthy backend even after the retry
+        # budget: transport-class (exit 2), NEVER a spec verdict
+        _client_die(f"{op}: fleet has no healthy backend: {e}")
     _client_die(f"{op} failed: {e}")
 
 
 def _print_job_line(j: dict) -> None:
     extra = ""
-    if j.get("state") == "done":
+    if j.get("state") == "done" and (
+        "status" in j or "distinct_states" in j or "steps" in j
+    ):
         if j.get("mode") == "simulate":
             extra = (
                 f"  {j.get('status', '?')} "
@@ -710,10 +717,14 @@ def _print_job_line(j: dict) -> None:
         # the reuse decision (docs/incremental.md): continue / reseed
         # with its match, or cold with the typed fallback reason
         warm = f" warm={j['warm_mode']}:{j.get('warm_reason')}"
+    # a fleet listing row names its owning backend (and may omit the
+    # slice counters, which live on the backend, not the dispatcher)
+    at = f" @{j['backend']}" if j.get("backend") else ""
     print(
-        f"{j['job_id']}  {j['spec']:<16} {j['state']:<10} "
+        f"{j['job_id']}  {j.get('spec') or '?':<16} "
+        f"{j.get('state') or '?':<10} "
         f"slices={j.get('slices', 0)} suspends={j.get('suspends', 0)}"
-        f"{warm}{extra}"
+        f"{warm}{extra}{at}"
     )
 
 
@@ -790,6 +801,7 @@ def _cmd_serve(args) -> int:
     config = ServiceConfig(
         state_dir=os.path.abspath(args.state_dir),
         socket_path=args.socket or "",
+        devices=args.devices,
         slice_s=args.slice,
         max_states=args.maxstates,
         checkpoint_every=args.checkpoint_every,
@@ -827,6 +839,44 @@ def _cmd_serve(args) -> int:
     if daemon.tcp_port is not None:
         print(f"serving on tcp port {daemon.tcp_port}", flush=True)
     daemon.serve_forever(drain=args.drain)
+    return 0
+
+
+def _cmd_dispatch(args) -> int:
+    from pulsar_tlaplus_tpu.fleet.dispatcher import (
+        FleetConfig,
+        FleetDispatcher,
+    )
+
+    def log(msg: str) -> None:
+        print(f"tpu-tlc dispatch: {msg}", file=sys.stderr, flush=True)
+
+    config = FleetConfig(
+        state_dir=os.path.abspath(args.state_dir),
+        backends=tuple(args.backend or ()),
+        socket_path=args.socket or "",
+        tcp=args.tcp or "",
+        tokens_path=args.tokens or "",
+        health_interval_s=args.health_interval,
+        fail_after=args.fail_after,
+        backend_timeout_s=args.backend_timeout,
+        replicate=not args.no_replicate,
+    )
+    try:
+        disp = FleetDispatcher(config, log=log)
+    except (RuntimeError, ValueError) as e:  # lock held / bad tokens
+        sys.exit(f"tpu-tlc: {e}")
+    try:
+        disp.start()
+    except OSError as e:
+        disp.shutdown()
+        sys.exit(f"tpu-tlc: cannot listen: {e}")
+    disp.install_signal_handlers()
+    # the ready line goes to STDOUT so wrappers/tests can block on it
+    print(f"dispatching on {config.socket_path}", flush=True)
+    if disp.tcp_port is not None:
+        print(f"dispatching on tcp port {disp.tcp_port}", flush=True)
+    disp.serve_forever()
     return 0
 
 
@@ -1570,6 +1620,65 @@ def main(argv=None):
         "the persisted queue, then stop)",
     )
     ps.add_argument("-cpu", action="store_true", help="force the CPU backend")
+    ps.add_argument(
+        "--devices", type=int, default=1, metavar="N",
+        help="local device slots the scheduler runs jobs on "
+        "concurrently (one worker thread + checker pool per slot; "
+        "default 1 — the single-chip time-slicing shape)",
+    )
+
+    pd = sub.add_parser(
+        "dispatch",
+        help="fleet dispatcher: front N `serve` daemons behind one "
+        "authenticated endpoint speaking the same wire protocol — "
+        "load-signal routing, warm-artifact replication, failover "
+        "(docs/fleet.md)",
+    )
+    pd.add_argument(
+        "state_dir", nargs="?",
+        default=os.path.expanduser("~/.ptt_fleet"),
+        help="dispatcher state directory (socket, fleet_jobs.json; "
+        "default ~/.ptt_fleet)",
+    )
+    pd.add_argument(
+        "--backend", action="append", default=None, metavar="ADDR",
+        help="backend daemon address (repeatable; a unix socket path "
+        "or tcp://HOST:PORT — TCP backends need a tokens.json entry "
+        "for the 'fleet' tenant)",
+    )
+    pd.add_argument(
+        "--socket", default=None, help="override dispatcher socket path"
+    )
+    pd.add_argument(
+        "--tcp", default=None, metavar="HOST:PORT",
+        help="additionally listen on an authenticated TCP socket "
+        "(port 0 = ephemeral; REQUIRES --tokens)",
+    )
+    pd.add_argument(
+        "--tokens", default=None, metavar="FILE",
+        help="tokens.json shared with the backends (client tokens "
+        "are forwarded; the 'fleet' entry is the dispatcher's own "
+        "identity)",
+    )
+    pd.add_argument(
+        "--health-interval", type=float, default=0.5, metavar="SEC",
+        help="backend health-poll period (default 0.5s)",
+    )
+    pd.add_argument(
+        "--fail-after", type=int, default=3, metavar="N",
+        help="consecutive failed polls before a backend is drained "
+        "from routing (default 3)",
+    )
+    pd.add_argument(
+        "--backend-timeout", type=float, default=10.0, metavar="SEC",
+        help="per-request timeout toward a backend (default 10s)",
+    )
+    pd.add_argument(
+        "--no-replicate", action="store_true",
+        help="disable warm-artifact replication between backends "
+        "(jobs still route and fail over; resubmits only warm-start "
+        "on their original backend)",
+    )
 
     pj = sub.add_parser(
         "submit", help="queue a check job on the running daemon"
@@ -2242,6 +2351,7 @@ def main(argv=None):
     if args.cmd != "check":
         return {
             "serve": _cmd_serve,
+            "dispatch": _cmd_dispatch,
             "simulate": _cmd_simulate,
             "tune": _cmd_tune,
             "submit": _cmd_submit,

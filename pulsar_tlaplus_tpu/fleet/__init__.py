@@ -1,0 +1,30 @@
+"""Fleet tier (r20): N checker daemons behind one dispatcher.
+
+The paper's north star is a checking service for "heavy traffic from
+millions of users"; one hardened daemon (r17) time-slices one chip.
+This package is the horizontal axis: a dispatcher daemon
+(``cli.py dispatch``, :mod:`fleet.dispatcher`) fronts several
+``serve`` daemons behind one authenticated endpoint speaking the
+SAME r17 wire protocol — clients are unchanged.  Three mechanisms:
+
+- **Routing** (:mod:`fleet.registry`): a health loop polls each
+  backend's ``ping``/``metrics`` verbs and places submits by the
+  live ``ptt_*`` signal (queue depth, active-job load, admission
+  sheds), with per-tenant stickiness only while warm locality pays.
+- **Replication** (:mod:`fleet.replicate`): on job completion the
+  owning daemon's warm artifact is offered to peers via a sieve
+  handshake — manifest digests first, ship only the blobs a peer is
+  missing, each delta-compressed with the r16 plane codec — so a
+  resubmit landing on ANY backend warm-starts (the spec-CI fleet
+  story; wire discipline after Compression-and-Sieve,
+  arXiv:1208.5542).
+- **Failover**: a backend that stops answering is drained from
+  routing and its queued (not running) jobs are resubmitted
+  elsewhere through the idempotent ``submit_id`` dedup path;
+  ``scripts/chaos.py --fleet`` kills a backend mid-job and pins the
+  resubmitted job's result state-for-state against a solo run.
+
+The vertical axis rides along: ``ServiceConfig.devices`` generalizes
+one daemon's scheduler from a single time-sliced chip to N local
+device slots (service/scheduler.py).  See docs/fleet.md.
+"""

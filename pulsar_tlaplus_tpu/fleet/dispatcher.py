@@ -1,0 +1,936 @@
+"""The fleet dispatcher daemon (``cli.py dispatch``).
+
+One authenticated endpoint fronting N ``serve`` backends, speaking
+the SAME r17 wire protocol — a client pointed at the dispatcher needs
+zero changes.  The dispatcher holds no checker, no device, no queue
+of its own: it is a routing table (fleet/registry.py), a job->backend
+map persisted to ``fleet_jobs.json``, and a health thread.
+
+Per request:
+
+- ``submit`` is placed by :meth:`BackendRegistry.choose` (live
+  ``ptt_*`` signal + warm stickiness) and forwarded verbatim — with a
+  dispatcher-pinned ``submit_id`` so a failover resubmit later rides
+  the backend's idempotent dedup path.  A whole-fleet outage answers
+  the typed ``backend_unavailable`` rejection (client exit 2 — a
+  routing failure must never read as a spec verdict).
+- ``status``/``result``/``cancel`` are proxied to the owning backend;
+  ``watch`` relays the backend's stream line-for-line.
+- ``metrics`` renders the dispatcher's OWN ``ptt_fleet_*`` families
+  (obs/metrics.py ``fleet_metrics``) from host-side counters — a
+  scrape never costs a backend round-trip.
+
+The health thread drives everything asynchronous: registry polls
+(drain after ``fail_after`` consecutive failures), failover (a
+drained backend's queued — not running — jobs resubmitted elsewhere
+through ``submit_id`` dedup), and warm-artifact replication (a job
+reaching a terminal state triggers a sieve pass from its owner to
+every peer, fleet/replicate.py, so the NEXT submit warm-starts
+anywhere).
+
+Auth model: clients authenticate to the dispatcher exactly as to a
+single daemon (bearer token over TCP, trusted unix socket locally).
+The dispatcher forwards the client's own token to TCP backends —
+per-tenant quotas and telemetry attribution hold end-to-end — and
+authenticates AS ``auth.FLEET_TENANT`` for its own polling and
+replication traffic.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from pulsar_tlaplus_tpu.fleet import replicate as replmod
+from pulsar_tlaplus_tpu.fleet.registry import BackendRegistry
+from pulsar_tlaplus_tpu.obs import telemetry as obs
+from pulsar_tlaplus_tpu.service import auth as authmod
+from pulsar_tlaplus_tpu.service import jobs as jobmod
+from pulsar_tlaplus_tpu.service import protocol
+
+# job-table states the dispatcher itself assigns (beyond jobs.STATES):
+# a job that was RUNNING on a backend that died is not silently
+# resubmitted (its partial warm artifact may not have replicated yet
+# — the operator or client resubmits through the dispatcher and lands
+# warm wherever replication reached)
+LOST = "lost"
+
+# submit fields forwarded verbatim to the chosen backend
+_SUBMIT_FIELDS = (
+    "spec", "cfg", "invariants", "max_states", "time_budget_s",
+    "priority", "deadline_s", "mode", "sim", "warm",
+)
+
+
+@dataclass
+class FleetConfig:
+    state_dir: str
+    backends: Tuple[str, ...] = ()
+    socket_path: str = ""  # default <state_dir>/dispatch.sock
+    tcp: str = ""  # HOST:PORT for the authenticated client listener
+    tokens_path: str = ""
+    health_interval_s: float = 0.5
+    fail_after: int = 3
+    backend_timeout_s: float = 10.0
+    sticky_s: float = 300.0
+    replicate: bool = True
+    telemetry_path: str = ""  # default <state_dir>/dispatch.jsonl
+
+    def __post_init__(self):
+        if not self.socket_path:
+            self.socket_path = os.path.join(
+                self.state_dir, "dispatch.sock"
+            )
+        if not self.telemetry_path:
+            self.telemetry_path = os.path.join(
+                self.state_dir, "dispatch.jsonl"
+            )
+
+    @property
+    def jobs_path(self) -> str:
+        return os.path.join(self.state_dir, "fleet_jobs.json")
+
+
+class FleetDispatcher:
+    def __init__(self, config: FleetConfig, log=None):
+        if not config.backends:
+            raise ValueError(
+                "dispatch needs at least one --backend ADDR"
+            )
+        self.config = config
+        os.makedirs(config.state_dir, exist_ok=True)
+        self._log = log or (lambda m: None)
+        self._lock_fd: Optional[int] = None
+        self._acquire_state_lock()
+        self.tel = obs.Telemetry(config.telemetry_path)
+        self.tokens: dict = {}
+        if config.tokens_path:
+            self.tokens = authmod.load_tokens(config.tokens_path)
+        if config.tcp and not self.tokens:
+            raise ValueError(
+                "dispatch --tcp requires --tokens TOKENS.json: the "
+                "TCP transport is authenticated (docs/fleet.md)"
+            )
+        # tenant -> token (first wins), for forwarding on behalf of a
+        # tenant during failover resubmit; the FLEET_TENANT entry is
+        # the dispatcher's own identity toward TCP backends
+        self._tenant_tokens: Dict[str, str] = {}
+        for token, tenant in self.tokens.items():
+            self._tenant_tokens.setdefault(tenant, token)
+        self.fleet_token = self._tenant_tokens.get(
+            authmod.FLEET_TENANT
+        )
+        if any(protocol.is_tcp(a) for a in config.backends) and (
+            self.fleet_token is None
+        ):
+            raise ValueError(
+                "TCP backends need a tokens.json entry for tenant "
+                f"{authmod.FLEET_TENANT!r} (the dispatcher's own "
+                "identity for health polls and replication; "
+                "docs/fleet.md Security)"
+            )
+        self.registry = BackendRegistry(
+            list(config.backends),
+            token=self.fleet_token,
+            fail_after=config.fail_after,
+            timeout=config.backend_timeout_s,
+            sticky_s=config.sticky_s,
+            log=self._log,
+        )
+        self._tcp_addr = None
+        if config.tcp:
+            self._tcp_addr = protocol.parse_tcp(
+                protocol.TCP_PREFIX + config.tcp
+            )
+        # job_id -> {backend, tenant, state, submit_id, submit{...},
+        #            done_handled}
+        self._jobs: Dict[str, dict] = {}
+        self._jobs_lock = threading.Lock()
+        self._load_jobs()
+        # host-side counters behind metrics_snapshot()
+        self._ctr_lock = threading.Lock()
+        self._routes: Dict[Tuple[str, str], float] = {}
+        self._route_s = 0.0
+        self._repl_blobs: Dict[str, float] = {}
+        self._repl_bytes: Dict[str, float] = {}
+        self._failovers: Dict[str, float] = {}
+        self._resub: Dict[str, float] = {}
+        self._sock: Optional[socket.socket] = None
+        self._tcp_sock: Optional[socket.socket] = None
+        self.tcp_port: Optional[int] = None
+        self._accept_threads: list = []
+        self._health_thread: Optional[threading.Thread] = None
+        self._shutdown_evt = threading.Event()
+        self._shutdown_done = threading.Event()
+        self._t0 = time.time()
+        self._auth_seen: set = set()
+        self._auth_seen_lock = threading.Lock()
+
+    def _acquire_state_lock(self) -> None:
+        """One dispatcher per state dir (same flock discipline as
+        server.py: kernel-released on any process death)."""
+        path = os.path.join(self.config.state_dir, "dispatch.lock")
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            pid = b"?"
+            try:
+                pid = os.pread(fd, 32, 0).strip() or b"?"
+            except OSError:
+                pass
+            os.close(fd)
+            raise RuntimeError(
+                f"another dispatcher (pid {pid.decode()}) already "
+                f"serves {self.config.state_dir}"
+            ) from None
+        os.ftruncate(fd, 0)
+        os.pwrite(fd, str(os.getpid()).encode(), 0)
+        self._lock_fd = fd
+
+    # --------------------------------------------------- job table
+
+    def _load_jobs(self) -> None:
+        try:
+            with open(self.config.jobs_path) as f:
+                snap = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        if isinstance(snap, dict) and isinstance(
+            snap.get("jobs"), dict
+        ):
+            self._jobs = {
+                str(k): v
+                for k, v in snap["jobs"].items()
+                if isinstance(v, dict)
+            }
+
+    def _save_jobs_locked(self) -> None:
+        tmp = self.config.jobs_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"fleet_jobs_v": 1, "jobs": self._jobs}, f)
+            os.replace(tmp, self.config.jobs_path)
+        except OSError as e:
+            self._log(f"fleet: jobs persist failed ({e!r:.120})")
+
+    def _record_job(self, job_id: str, rec: dict) -> None:
+        with self._jobs_lock:
+            self._jobs[job_id] = rec
+            self._save_jobs_locked()
+
+    def _update_job(self, job_id: str, **fields) -> None:
+        with self._jobs_lock:
+            rec = self._jobs.get(job_id)
+            if rec is None:
+                return
+            rec.update(fields)
+            self._save_jobs_locked()
+
+    # ----------------------------------------------------- metrics
+
+    def metrics_snapshot(self) -> dict:
+        """Host-side counter copies for ``obs.metrics.fleet_metrics``
+        — never a backend round-trip."""
+        with self._ctr_lock:
+            return {
+                "backends": self.registry.snapshot(),
+                "routes": dict(self._routes),
+                "route_s": self._route_s,
+                "repl_blobs": dict(self._repl_blobs),
+                "repl_bytes": dict(self._repl_bytes),
+                "failovers": dict(self._failovers),
+                "resubmitted": dict(self._resub),
+            }
+
+    # --------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        try:
+            os.remove(self.config.socket_path)
+        except OSError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(self.config.socket_path)
+        s.listen(16)
+        s.settimeout(0.5)
+        self._sock = s
+        if self._tcp_addr is not None:
+            host, port = self._tcp_addr
+            ts = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ts.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ts.bind((host, port))
+            ts.listen(16)
+            ts.settimeout(0.5)
+            self._tcp_sock = ts
+            self.tcp_port = ts.getsockname()[1]
+            self._log(
+                f"fleet TCP listener on {host}:{self.tcp_port} "
+                f"({len(self.tokens)} tenant token(s) loaded)"
+            )
+        self.tel.emit(
+            "serve",
+            action="start",
+            socket=self.config.socket_path,
+            tcp_port=self.tcp_port,
+            pid=os.getpid(),
+            warmed=[],
+            wall_unix=round(time.time(), 3),
+        )
+        # one synchronous poll before accepting: first submits route
+        # on real signal, not the optimistic all-up default
+        self.registry.poll_once()
+        listeners = [(s, True)]
+        if self._tcp_sock is not None:
+            listeners.append((self._tcp_sock, False))
+        for sock, trusted in listeners:
+            t = threading.Thread(
+                target=self._accept_loop, args=(sock, trusted),
+                name="ptt-dispatch-accept", daemon=True,
+            )
+            t.start()
+            self._accept_threads.append(t)
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="ptt-fleet-health",
+            daemon=True,
+        )
+        self._health_thread.start()
+        self._log(
+            f"dispatching {len(self.config.backends)} backend(s) on "
+            f"{self.config.socket_path}"
+        )
+
+    def install_signal_handlers(self) -> None:
+        def _handle(signum, frame):
+            self._log(
+                f"{signal.Signals(signum).name} received: stopping "
+                "the dispatcher (backends keep running)"
+            )
+            self.request_shutdown()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _handle)
+
+    def request_shutdown(self) -> None:
+        self._shutdown_evt.set()
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> None:
+        self._shutdown_evt.wait(timeout)
+        if self._shutdown_evt.is_set():
+            self.shutdown()
+
+    def serve_forever(self) -> None:
+        while not self._shutdown_evt.is_set():
+            self._shutdown_evt.wait(0.2)
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._shutdown_done.is_set():
+            return
+        self._shutdown_done.set()
+        self._shutdown_evt.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=30.0)
+        for attr in ("_sock", "_tcp_sock"):
+            sock = getattr(self, attr)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+        try:
+            os.remove(self.config.socket_path)
+        except OSError:
+            pass
+        with self._jobs_lock:
+            self._save_jobs_locked()
+        self.tel.emit("serve", action="stop", pid=os.getpid())
+        self.tel.close()
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = None
+        self._log("dispatcher shutdown complete (backends untouched)")
+
+    # ------------------------------------------------ health thread
+
+    def _health_loop(self) -> None:
+        while not self._shutdown_evt.is_set():
+            try:
+                for b in self.registry.poll_once():
+                    self._failover(b)
+                self._sweep_jobs()
+            except Exception as e:  # noqa: BLE001 — the health loop
+                #                      must survive any single pass
+                self._log(f"fleet: health pass failed ({e!r:.200})")
+            self._shutdown_evt.wait(self.config.health_interval_s)
+
+    def _token_for(self, tenant: str, addr: str) -> Optional[str]:
+        """The bearer token to present at ``addr`` on behalf of
+        ``tenant`` (None over unix).  Falls back to the fleet token
+        when the tenant has none — attribution degrades, routing
+        does not."""
+        if not protocol.is_tcp(addr):
+            return None
+        return self._tenant_tokens.get(tenant) or self.fleet_token
+
+    def _failover(self, backend) -> None:
+        """A backend was drained THIS health pass: resubmit its
+        QUEUED jobs elsewhere through the idempotent ``submit_id``
+        dedup path; mark its running/suspended jobs ``lost`` (their
+        client resubmits through the dispatcher and warm-starts
+        wherever replication reached)."""
+        with self._jobs_lock:
+            owned = [
+                (jid, dict(rec))
+                for jid, rec in self._jobs.items()
+                if rec.get("backend") == backend.addr
+                and rec.get("state")
+                not in (
+                    jobmod.DONE, jobmod.FAILED, jobmod.CANCELLED, LOST,
+                )
+            ]
+        resubmitted = 0
+        for jid, rec in owned:
+            if rec.get("state") != jobmod.QUEUED:
+                self._update_job(jid, state=LOST)
+                continue
+            target, reason = self.registry.choose(
+                rec.get("tenant", authmod.LOCAL_TENANT)
+            )
+            if target is None or target.addr == backend.addr:
+                self._update_job(jid, state=LOST)
+                continue
+            fwd = dict(rec.get("submit") or {})
+            fwd["submit_id"] = rec.get("submit_id")
+            auth = self._token_for(
+                rec.get("tenant", authmod.LOCAL_TENANT), target.addr
+            )
+            try:
+                resp = protocol.request(
+                    target.addr, "submit",
+                    timeout=self.config.backend_timeout_s,
+                    **({"auth": auth} if auth else {}), **fwd,
+                )
+            except (OSError, protocol.ProtocolError) as e:
+                self._log(
+                    f"fleet: failover resubmit of {jid} to "
+                    f"{target.addr} failed ({e!r:.120})"
+                )
+                self._update_job(jid, state=LOST)
+                continue
+            if not resp.get("ok"):
+                self._log(
+                    f"fleet: failover resubmit of {jid} refused "
+                    f"({resp.get('error')})"
+                )
+                self._update_job(jid, state=LOST)
+                continue
+            new_id = resp.get("job_id")
+            self._update_job(
+                jid,
+                backend=target.addr,
+                state=resp.get("state", jobmod.QUEUED),
+                backend_job_id=new_id,
+            )
+            if new_id and new_id != jid:
+                # the new backend minted a fresh id: alias it so
+                # status/result/watch against either id resolve
+                self._record_job(
+                    new_id,
+                    {
+                        **rec,
+                        "backend": target.addr,
+                        "state": resp.get("state", jobmod.QUEUED),
+                        "alias_of": jid,
+                    },
+                )
+            resubmitted += 1
+        with self._ctr_lock:
+            self._failovers[backend.addr] = (
+                self._failovers.get(backend.addr, 0) + 1
+            )
+            self._resub[backend.addr] = (
+                self._resub.get(backend.addr, 0) + resubmitted
+            )
+        self.tel.emit(
+            "failover", backend=backend.addr, resubmitted=resubmitted
+        )
+        self._log(
+            f"fleet: failover from {backend.addr} "
+            f"({resubmitted} queued job(s) resubmitted)"
+        )
+
+    def _sweep_jobs(self) -> None:
+        """Track every routed job to its terminal state; a terminal
+        transition triggers one replication pass from the owner so
+        its warm artifact lands on every peer."""
+        with self._jobs_lock:
+            open_jobs = [
+                (jid, rec.get("backend"), rec.get("backend_job_id"))
+                for jid, rec in self._jobs.items()
+                if not rec.get("done_handled")
+                and rec.get("state") != LOST
+                and not rec.get("alias_of")
+            ]
+        up = {b.addr for b in self.registry.healthy()}
+        for jid, addr, backend_jid in open_jobs:
+            if addr not in up:
+                continue
+            auth = self.fleet_token if protocol.is_tcp(addr) else None
+            try:
+                resp = protocol.request(
+                    addr, "status",
+                    timeout=self.config.backend_timeout_s,
+                    job_id=backend_jid or jid,
+                    **({"auth": auth} if auth else {}),
+                )
+            except (OSError, protocol.ProtocolError):
+                continue  # the registry poll will judge the backend
+            if not resp.get("ok"):
+                continue
+            state = (resp.get("job") or {}).get("state")
+            if state is None:
+                continue
+            terminal = state in (
+                jobmod.DONE, jobmod.FAILED, jobmod.CANCELLED,
+            )
+            self._update_job(
+                jid, state=state,
+                **({"done_handled": True} if terminal else {}),
+            )
+            if terminal and self.config.replicate:
+                self._replicate_from(addr)
+
+    def _replicate_from(self, src_addr: str) -> None:
+        """One sieve pass: every artifact on ``src_addr`` offered to
+        every healthy peer (fleet/replicate.py).  Repeats are cheap —
+        a current peer answers ``identical`` and no data moves."""
+        peers = [
+            b.addr for b in self.registry.healthy()
+            if b.addr != src_addr
+        ]
+        if not peers:
+            return
+
+        def on_pass(r: dict) -> None:
+            if r.get("status") not in ("ok",):
+                return
+            dst = r.get("dst") or "?"
+            with self._ctr_lock:
+                self._repl_blobs[dst] = self._repl_blobs.get(
+                    dst, 0
+                ) + int(r.get("blobs") or 0)
+                self._repl_bytes[dst] = self._repl_bytes.get(
+                    dst, 0
+                ) + int(r.get("wire_bytes") or 0)
+            self.tel.emit(
+                "replicate",
+                src=r.get("src"),
+                dst=dst,
+                blobs=int(r.get("blobs") or 0),
+                wire_bytes=int(r.get("wire_bytes") or 0),
+                config_sig=r.get("config_sig"),
+            )
+
+        try:
+            replmod.replicate_all(
+                src_addr, peers, token=self.fleet_token,
+                timeout=self.config.backend_timeout_s,
+                on_pass=on_pass,
+            )
+        except (OSError, protocol.ProtocolError) as e:
+            self._log(
+                f"fleet: replication from {src_addr} failed "
+                f"({e!r:.120})"
+            )
+
+    # ---------------------------------------------------- connection
+
+    def _accept_loop(self, sock: socket.socket, trusted: bool) -> None:
+        while not self._shutdown_evt.is_set():
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn, trusted),
+                daemon=True,
+            )
+            t.start()
+
+    def _handle_conn(
+        self, conn: socket.socket, trusted: bool = True
+    ) -> None:
+        conn.settimeout(600.0)
+        r = w = None
+        try:
+            r = conn.makefile("r", encoding="utf-8")
+            w = conn.makefile("w", encoding="utf-8")
+            try:
+                req = protocol.recv_json(r)
+            except protocol.ProtocolError as e:
+                protocol.send_json(
+                    w, protocol.error_response(str(e), code="protocol")
+                )
+                return
+            if req is None:
+                return
+            if not trusted:
+                tenant = authmod.authenticate(
+                    self.tokens, req.get("auth")
+                )
+                if tenant is None:
+                    self.tel.emit(
+                        "auth", action="reject", op=req.get("op"),
+                    )
+                    protocol.send_json(
+                        w,
+                        protocol.error_response(
+                            "bad or missing bearer token "
+                            "(submit with --token; docs/fleet.md)",
+                            code="auth",
+                        ),
+                    )
+                    return
+                with self._auth_seen_lock:
+                    first = tenant not in self._auth_seen
+                    self._auth_seen.add(tenant)
+                if first:
+                    self.tel.emit(
+                        "auth", action="accept", tenant=tenant
+                    )
+                req["_tenant"] = tenant
+            else:
+                req["_tenant"] = authmod.LOCAL_TENANT
+            op = req.get("op")
+            handler = getattr(self, f"_op_{op}", None)
+            if op not in protocol.OPS or handler is None:
+                protocol.send_json(
+                    w,
+                    protocol.error_response(
+                        f"unknown op {op!r} (dispatcher ops: ping/"
+                        "submit/status/result/cancel/watch/metrics/"
+                        "shutdown)"
+                    ),
+                )
+                return
+            try:
+                handler(req, w)
+            except (BrokenPipeError, ConnectionResetError):
+                raise
+            except (OSError, protocol.ProtocolError) as e:
+                # a backend died mid-proxy: transport-class, so the
+                # client retries / exits 2 — never a spec verdict
+                protocol.send_json(
+                    w,
+                    protocol.error_response(
+                        f"backend unreachable ({e!r:.120})",
+                        code="backend_unavailable",
+                    ),
+                )
+            except (KeyError, ValueError, TypeError) as e:
+                protocol.send_json(w, protocol.error_response(str(e)))
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            for obj in (w, r):
+                try:
+                    if obj is not None:
+                        obj.close()
+                except OSError:
+                    pass
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------ handlers
+
+    def _op_ping(self, req, w) -> None:
+        with self._jobs_lock:
+            counts: dict = {}
+            for rec in self._jobs.values():
+                if rec.get("alias_of"):
+                    continue
+                st = rec.get("state", "?")
+                counts[st] = counts.get(st, 0) + 1
+        protocol.send_json(
+            w,
+            {
+                "ok": True,
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - self._t0, 1),
+                "fleet": True,
+                "backends": self.registry.snapshot(),
+                "jobs": counts,
+                "warmed": [],
+            },
+        )
+
+    def _op_submit(self, req, w) -> None:
+        t0 = time.monotonic()
+        tenant = req["_tenant"]
+        submit_id = req.get("submit_id") or uuid.uuid4().hex
+        # a resubmit of a known submit_id routes BACK to its owner:
+        # the backend's dedup can only answer the same job if the
+        # retry lands on the same daemon
+        sticky_owner = None
+        with self._jobs_lock:
+            for rec in self._jobs.values():
+                if rec.get("submit_id") == submit_id and not rec.get(
+                    "alias_of"
+                ):
+                    sticky_owner = rec.get("backend")
+                    break
+        fwd = {k: req[k] for k in _SUBMIT_FIELDS if k in req}
+        fwd["submit_id"] = submit_id
+        tried: set = set()
+        last_err = "no healthy backend"
+        healthy = sorted(
+            self.registry.healthy(), key=lambda b: b.score()
+        )
+        candidates: List = []
+        if sticky_owner is not None:
+            # a dedup-keyed retry must land on the SAME backend to
+            # get the same job back
+            for b in healthy:
+                if b.addr == sticky_owner:
+                    candidates.append((b, "sticky"))
+                    break
+        if healthy and not candidates:
+            chosen, why = self.registry.choose(tenant)
+            if chosen is not None:
+                candidates.append((chosen, why))
+        # every other healthy backend is a fallback: a connect
+        # failure on the first pick must not bounce the submit while
+        # the fleet still has capacity
+        placed = {c.addr for c, _ in candidates}
+        for b in healthy:
+            if b.addr not in placed:
+                candidates.append((b, "least_loaded"))
+                placed.add(b.addr)
+        if not candidates:
+            protocol.send_json(
+                w,
+                protocol.error_response(
+                    "no healthy backend in the fleet (all drained); "
+                    "retry later",
+                    code="backend_unavailable",
+                ),
+            )
+            return
+        for backend, why in candidates:
+            if backend.addr in tried:
+                continue
+            tried.add(backend.addr)
+            auth = req.get("auth") or self._token_for(
+                tenant, backend.addr
+            )
+            if not protocol.is_tcp(backend.addr):
+                auth = None
+            try:
+                resp = protocol.request(
+                    backend.addr, "submit",
+                    timeout=self.config.backend_timeout_s,
+                    **({"auth": auth} if auth else {}), **fwd,
+                )
+            except (OSError, protocol.ProtocolError) as e:
+                last_err = f"{backend.addr}: {e!r:.120}"
+                continue
+            if not resp.get("ok"):
+                # typed backend rejection (quota/capacity/auth/...)
+                # relays verbatim: the client's exit-code mapping
+                # must see the backend's own code
+                protocol.send_json(w, resp)
+                return
+            route_ms = (time.monotonic() - t0) * 1000.0
+            jid = resp["job_id"]
+            self._record_job(
+                jid,
+                {
+                    "backend": backend.addr,
+                    "tenant": tenant,
+                    "state": resp.get("state", jobmod.QUEUED),
+                    "submit_id": submit_id,
+                    "submit": fwd,
+                    "done_handled": False,
+                },
+            )
+            with self._ctr_lock:
+                key = (backend.addr, why)
+                self._routes[key] = self._routes.get(key, 0) + 1
+                self._route_s += route_ms / 1000.0
+            self.tel.emit(
+                "route",
+                backend=backend.addr,
+                tenant=tenant,
+                reason=why,
+                route_ms=round(route_ms, 3),
+                job_id=jid,
+            )
+            protocol.send_json(
+                w, {**resp, "backend": backend.addr}
+            )
+            return
+        protocol.send_json(
+            w,
+            protocol.error_response(
+                f"every healthy backend refused the connection "
+                f"(last: {last_err})",
+                code="backend_unavailable",
+            ),
+        )
+
+    def _owner_of(self, req) -> Tuple[str, str, Optional[str]]:
+        """(backend addr, backend-side job id, forward token) for the
+        request's ``job_id``; raises ValueError when untracked."""
+        jid = req["job_id"]
+        with self._jobs_lock:
+            rec = self._jobs.get(jid)
+        if rec is None:
+            raise ValueError(
+                f"unknown job {jid!r} (not routed through this "
+                "dispatcher)"
+            )
+        if rec.get("state") == LOST:
+            raise ValueError(
+                f"job {jid!r} was lost with its backend "
+                f"({rec.get('backend')}); resubmit through the "
+                "dispatcher to warm-start on a live one"
+            )
+        addr = rec["backend"]
+        auth = req.get("auth") or self._token_for(
+            rec.get("tenant", authmod.LOCAL_TENANT), addr
+        )
+        if not protocol.is_tcp(addr):
+            auth = None
+        return addr, rec.get("backend_job_id") or jid, auth
+
+    def _proxy(self, req, w, op: str, **extra) -> None:
+        addr, backend_jid, auth = self._owner_of(req)
+        resp = protocol.request(
+            addr, op, timeout=self.config.backend_timeout_s,
+            job_id=backend_jid,
+            **({"auth": auth} if auth else {}), **extra,
+        )
+        if op == "result" and resp.get("ok") and not resp.get(
+            "pending"
+        ):
+            self._update_job(
+                req["job_id"], state=resp.get("state"),
+            )
+        protocol.send_json(w, {**resp, "backend": addr})
+
+    def _op_status(self, req, w) -> None:
+        if req.get("job_id"):
+            self._proxy(req, w, "status")
+            return
+        # fleet-level listing: the dispatcher's own routing table,
+        # tenant-scoped over TCP exactly like a single daemon's
+        tenant = req.get("_tenant")
+        with self._jobs_lock:
+            jobs = [
+                {
+                    "job_id": jid,
+                    # spec/mode from the forwarded submit, so `ptt
+                    # status` renders a fleet listing with the same
+                    # columns as a single daemon's
+                    "spec": (rec.get("submit") or {}).get("spec"),
+                    "mode": (rec.get("submit") or {}).get(
+                        "mode", "check"
+                    ),
+                    "state": rec.get("state"),
+                    "tenant": rec.get("tenant"),
+                    "backend": rec.get("backend"),
+                }
+                for jid, rec in sorted(self._jobs.items())
+                if not rec.get("alias_of")
+                and (
+                    tenant == authmod.LOCAL_TENANT
+                    or rec.get("tenant") == tenant
+                )
+            ]
+        protocol.send_json(w, {"ok": True, "jobs": jobs})
+
+    def _op_result(self, req, w) -> None:
+        self._proxy(req, w, "result")
+
+    def _op_cancel(self, req, w) -> None:
+        self._proxy(req, w, "cancel")
+
+    def _op_watch(self, req, w) -> None:
+        """Relay the owning backend's watch stream line-for-line;
+        the client's (run_id, seq) dedup and ``pos`` resume work
+        unchanged because the dispatcher forwards both verbatim."""
+        addr, backend_jid, auth = self._owner_of(req)
+        timeout_s = float(req.get("timeout_s", 3600.0))
+        # raw relay (not protocol.stream, which EATS the ack): the
+        # backend's acknowledgment, every event, and the done summary
+        # all pass through byte-equivalent, so the client's dedup and
+        # pos-resume machinery cannot tell a dispatcher from a daemon
+        with protocol.connect(addr, timeout_s + 30.0) as s:
+            br = s.makefile("r", encoding="utf-8")
+            bw = s.makefile("w", encoding="utf-8")
+            protocol.send_json(
+                bw,
+                {
+                    "op": "watch",
+                    "job_id": backend_jid,
+                    "timeout_s": timeout_s,
+                    "offset": max(0, int(req.get("offset") or 0)),
+                    **({"auth": auth} if auth else {}),
+                },
+            )
+            while True:
+                msg = protocol.recv_json(br)
+                if msg is None:
+                    raise protocol.ProtocolError(
+                        "backend closed the watch stream mid-relay"
+                    )
+                protocol.send_json(w, msg)
+                if "done" in msg or "error" in msg:
+                    return
+                if not msg.get("ok", True):
+                    return
+
+    def _op_metrics(self, req, w) -> None:
+        from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
+
+        text = metrics_mod.render_exposition(
+            metrics_mod.fleet_metrics(
+                self, uptime_s=time.time() - self._t0
+            )
+        )
+        protocol.send_json(w, {"ok": True, "metrics": text})
+
+    def _op_shutdown(self, req, w) -> None:
+        if req.get("_tenant") != authmod.LOCAL_TENANT:
+            protocol.send_json(
+                w,
+                protocol.error_response(
+                    "shutdown is localhost-only (connect via the "
+                    "unix socket)",
+                    code="auth",
+                ),
+            )
+            return
+        protocol.send_json(w, {"ok": True, "stopping": True})
+        self.request_shutdown()

@@ -1,0 +1,209 @@
+"""Backend registry + health loop + routing policy.
+
+The dispatcher's view of its fleet: one :class:`Backend` per ``serve``
+daemon address, refreshed by polling the daemon's own ``ping`` and
+``metrics`` verbs — the routing signal IS the public ``ptt_*``
+exposition (queue depth, active-job load, admission sheds), so what
+the dashboards see is exactly what routing acts on, and a backend
+needs no fleet-specific instrumentation to join.
+
+Routing policy (docs/fleet.md, "Routing"):
+
+- only ``up`` backends are eligible; a backend is drained (``down``)
+  after ``fail_after`` consecutive poll failures and rejoins on its
+  first clean poll.
+- per-tenant stickiness ONLY while warm locality pays: a tenant's
+  last backend is reused while its load is within ``sticky_slack`` of
+  the best backend — a hot backend forfeits stickiness, because a
+  warm start saved is worth less than a queue stall paid.
+- otherwise least-loaded wins: ``queue_depth + running`` weighted
+  with a shed penalty (a backend actively shedding is overloaded by
+  its OWN admission's judgement, the strongest signal there is).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from pulsar_tlaplus_tpu.obs import metrics as obs_metrics
+from pulsar_tlaplus_tpu.service import protocol
+
+UP = "up"
+DOWN = "down"
+
+
+@dataclass
+class Backend:
+    """One ``serve`` daemon as the dispatcher sees it."""
+
+    addr: str
+    state: str = UP  # optimistic until the first poll says otherwise
+    failures: int = 0  # consecutive poll failures
+    last_ok_unix: float = 0.0
+    pid: Optional[int] = None
+    # routing signal, refreshed from ping + metrics each poll
+    queue_depth: int = 0
+    running: int = 0
+    sheds: float = 0.0
+    warmed: int = 0
+    # submits routed here since the last clean poll: the polled queue
+    # depth is up to one health interval stale, so a burst of submits
+    # between polls would all see the same score and pile onto one
+    # backend — the optimistic bump spreads the burst, and the next
+    # poll (whose queue_depth then counts the routed jobs) resets it
+    inflight: int = 0
+
+    def score(self) -> float:
+        """Lower routes sooner.  Sheds dominate: a backend whose own
+        admission control is refusing work must not be handed more."""
+        return (
+            float(self.queue_depth)
+            + float(self.running)
+            + float(self.inflight)
+            + 4.0 * min(float(self.sheds), 8.0)
+        )
+
+
+class BackendRegistry:
+    """Thread-safe registry; the dispatcher's health thread calls
+    :meth:`poll_once`, its handler threads call :meth:`choose` /
+    :meth:`healthy` / :meth:`snapshot`."""
+
+    def __init__(
+        self,
+        addrs: List[str],
+        token: Optional[str] = None,
+        fail_after: int = 3,
+        timeout: float = 5.0,
+        sticky_s: float = 300.0,
+        sticky_slack: float = 2.0,
+        log=None,
+    ):
+        if not addrs:
+            raise ValueError("a fleet needs at least one backend")
+        self.backends: Dict[str, Backend] = {
+            a: Backend(addr=a) for a in addrs
+        }
+        self.token = token
+        self.fail_after = max(1, int(fail_after))
+        self.timeout = timeout
+        self.sticky_s = sticky_s
+        self.sticky_slack = sticky_slack
+        self._log = log or (lambda msg: None)
+        self._lock = threading.Lock()
+        # tenant -> (addr, unix time of last placement)
+        self._sticky: Dict[str, Tuple[str, float]] = {}
+
+    # ------------------------------------------------------- polling
+
+    def _poll_backend(self, b: Backend) -> None:
+        auth = {"auth": self.token} if self.token else {}
+        ping = protocol.request(
+            b.addr, "ping", timeout=self.timeout, **auth
+        )
+        if not ping.get("ok"):
+            raise protocol.ProtocolError(
+                f"ping refused: {ping.get('error')}"
+            )
+        met = protocol.request(
+            b.addr, "metrics", timeout=self.timeout, **auth
+        )
+        if not met.get("ok"):
+            raise protocol.ProtocolError(
+                f"metrics refused: {met.get('error')}"
+            )
+        samples, _types = obs_metrics.parse_exposition(
+            met.get("metrics", "")
+        )
+
+        def total(name: str, want: Optional[Dict[str, str]] = None):
+            out = 0.0
+            for labels, value in samples.get(name, []):
+                if want and any(
+                    labels.get(k) != v for k, v in want.items()
+                ):
+                    continue
+                out += value
+            return out
+
+        b.pid = ping.get("pid")
+        b.queue_depth = int(total("ptt_queue_depth"))
+        b.running = int(total("ptt_jobs", {"state": "running"}))
+        b.sheds = total("ptt_admission_shed_total")
+        b.warmed = len(ping.get("warmed") or [])
+
+    def poll_once(self) -> List[Backend]:
+        """One health pass over every backend.  Returns the backends
+        that transitioned up -> down THIS pass (the dispatcher's
+        failover trigger fires exactly once per outage)."""
+        newly_down: List[Backend] = []
+        for b in list(self.backends.values()):
+            try:
+                self._poll_backend(b)
+            except (OSError, protocol.ProtocolError, ValueError) as e:
+                with self._lock:
+                    b.failures += 1
+                    if b.failures >= self.fail_after and b.state == UP:
+                        b.state = DOWN
+                        newly_down.append(b)
+                        self._log(
+                            f"fleet: backend {b.addr} drained after "
+                            f"{b.failures} failed polls ({e!r:.80})"
+                        )
+                continue
+            with self._lock:
+                if b.state == DOWN:
+                    self._log(f"fleet: backend {b.addr} rejoined")
+                b.state = UP
+                b.failures = 0
+                b.last_ok_unix = time.time()
+                b.inflight = 0  # the fresh queue_depth counts them
+        return newly_down
+
+    # ------------------------------------------------------- routing
+
+    def healthy(self) -> List[Backend]:
+        with self._lock:
+            return [b for b in self.backends.values() if b.state == UP]
+
+    def choose(self, tenant: str) -> Tuple[Optional[Backend], str]:
+        """The backend for one submit + the routing reason
+        (``sticky`` / ``least_loaded`` / ``only_backend``), or
+        ``(None, "no_backend")`` when the whole fleet is down — the
+        caller turns that into the typed ``backend_unavailable``
+        rejection."""
+        up = self.healthy()
+        if not up:
+            return None, "no_backend"
+        with self._lock:
+            if len(up) == 1:
+                b = up[0]
+                self._sticky[tenant] = (b.addr, time.time())
+                b.inflight += 1
+                return b, "only_backend"
+            best = min(up, key=lambda b: b.score())
+            prev = self._sticky.get(tenant)
+            if prev is not None:
+                addr, placed = prev
+                cand = self.backends.get(addr)
+                if (
+                    cand is not None
+                    and cand.state == UP
+                    and time.time() - placed <= self.sticky_s
+                    and cand.score()
+                    <= best.score() + self.sticky_slack
+                ):
+                    self._sticky[tenant] = (cand.addr, time.time())
+                    cand.inflight += 1
+                    return cand, "sticky"
+            self._sticky[tenant] = (best.addr, time.time())
+            best.inflight += 1
+            return best, "least_loaded"
+
+    def snapshot(self) -> Dict[str, str]:
+        """addr -> state, for the ``ptt_fleet_backends`` gauge."""
+        with self._lock:
+            return {a: b.state for a, b in self.backends.items()}

@@ -70,6 +70,12 @@ GATE_DIRECTIONS: Dict[str, str] = {
     # itself changed, which is the regression the tier-1 sim gate pins
     "walks_per_sec": "higher",
     "steps_per_state": "lower",
+    # fleet dispatcher (r20): queue throughput and route latency gate
+    # service-tier trajectories; replication wire bytes gate the sieve
+    # codec's economy (fewer bytes shipped for the same warm coverage)
+    "fleet_jobs_per_sec": "higher",
+    "fleet_route_ms": "lower",
+    "fleet_replicated_wire_bytes": "lower",
 }
 # the machine-independent subset — the tier-1 gate's default
 DETERMINISTIC_GATE_KEYS = (

@@ -328,6 +328,91 @@ def _warm_families(
     return out
 
 
+def _fleet_families(
+    backends: Dict[str, str],
+    routes: Dict[Tuple[str, str], float],
+    route_s: float,
+    repl_blobs: Dict[str, float],
+    repl_bytes: Dict[str, float],
+    failovers: Dict[str, float],
+    resubmitted: Dict[str, float],
+) -> List[Family]:
+    """The r20 fleet-dispatcher families (docs/fleet.md): backend
+    health by address, submit placements by backend and routing
+    reason (``sticky`` / ``least_loaded`` / ``only_backend``),
+    cumulative placement latency, the replication sieve's shipped
+    blobs + delta-compressed wire bytes by destination, and
+    failover drains + the queued jobs they resubmitted.  Identically
+    named from the live dispatcher and a stream tail."""
+    f_back = Family(
+        "ptt_fleet_backends", "gauge",
+        "Registered backends by address and health state",
+    )
+    for addr, state in sorted(backends.items()):
+        f_back.add(1, {"backend": addr, "state": state})
+    f_routes = Family(
+        "ptt_fleet_routes_total", "counter",
+        "Submits placed, by backend and routing reason",
+    )
+    for (addr, reason), n in sorted(routes.items()):
+        f_routes.add(n, {"backend": addr, "reason": reason})
+    f_route_s = Family(
+        "ptt_fleet_route_seconds_total", "counter",
+        "Cumulative placement latency (admission to backend ack)",
+    ).add(round(route_s, 6) if routes else None)
+    f_blobs = Family(
+        "ptt_fleet_replicated_blobs_total", "counter",
+        "Warm-artifact blobs shipped by the sieve, by destination",
+    )
+    for addr, n in sorted(repl_blobs.items()):
+        f_blobs.add(n, {"backend": addr})
+    f_bytes = Family(
+        "ptt_fleet_replicated_wire_bytes_total", "counter",
+        "Delta-compressed replication bytes on the wire, by "
+        "destination",
+    )
+    for addr, n in sorted(repl_bytes.items()):
+        f_bytes.add(n, {"backend": addr})
+    f_fail = Family(
+        "ptt_fleet_failovers_total", "counter",
+        "Backend drains (stopped answering), by backend",
+    )
+    for addr, n in sorted(failovers.items()):
+        f_fail.add(n, {"backend": addr})
+    f_resub = Family(
+        "ptt_fleet_resubmitted_total", "counter",
+        "Queued jobs resubmitted elsewhere on failover, by the "
+        "drained backend",
+    )
+    for addr, n in sorted(resubmitted.items()):
+        f_resub.add(n, {"backend": addr})
+    return [
+        f_back, f_routes, f_route_s, f_blobs, f_bytes, f_fail,
+        f_resub,
+    ]
+
+
+def fleet_metrics(dispatcher, uptime_s: Optional[float] = None) -> List[Family]:
+    """Metric families from a live FleetDispatcher — reads only its
+    host-side counter dicts (fleet/dispatcher.py), never a backend
+    round-trip: a dispatcher scrape must stay cheap while a backend
+    is down."""
+    snap = dispatcher.metrics_snapshot()
+    fams = [
+        Family(
+            "ptt_daemon_up", "gauge", "1 while the dispatcher answers"
+        ).add(1),
+        Family(
+            "ptt_daemon_uptime_seconds", "gauge", "Dispatcher uptime"
+        ).add(uptime_s),
+    ]
+    return fams + _fleet_families(
+        snap["backends"], snap["routes"], snap["route_s"],
+        snap["repl_blobs"], snap["repl_bytes"], snap["failovers"],
+        snap["resubmitted"],
+    )
+
+
 # ------------------------------------------------------- daemon scrape
 
 
@@ -480,8 +565,41 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     adm_rejected: Dict[Tuple[str, str], float] = {}
     adm_deduped: Dict[str, float] = {}
     warm_counts: Dict[Tuple[str, str], float] = {}
+    # fleet dispatcher stream (r20): backend state is the LAST signal
+    # seen per backend — a route marks it up, a failover marks it down
+    fleet_backends: Dict[str, str] = {}
+    fleet_routes: Dict[Tuple[str, str], float] = {}
+    fleet_route_s = 0.0
+    fleet_blobs: Dict[str, float] = {}
+    fleet_bytes: Dict[str, float] = {}
+    fleet_failovers: Dict[str, float] = {}
+    fleet_resub: Dict[str, float] = {}
     for e in events:
         ev = e.get("event")
+        if ev == "route":
+            addr = str(e.get("backend", "?"))
+            key = (addr, str(e.get("reason", "?")))
+            fleet_routes[key] = fleet_routes.get(key, 0) + 1
+            fleet_backends[addr] = "up"
+            if isinstance(e.get("route_ms"), (int, float)):
+                fleet_route_s += float(e["route_ms"]) / 1000.0
+        elif ev == "replicate":
+            dst = str(e.get("dst", "?"))
+            fleet_blobs[dst] = (
+                fleet_blobs.get(dst, 0) + float(e.get("blobs", 0) or 0)
+            )
+            fleet_bytes[dst] = (
+                fleet_bytes.get(dst, 0)
+                + float(e.get("wire_bytes", 0) or 0)
+            )
+        elif ev == "failover":
+            addr = str(e.get("backend", "?"))
+            fleet_failovers[addr] = fleet_failovers.get(addr, 0) + 1
+            fleet_resub[addr] = (
+                fleet_resub.get(addr, 0)
+                + float(e.get("resubmitted", 0) or 0)
+            )
+            fleet_backends[addr] = "down"
         if ev == "warm":
             # mirror the live daemon's counting points exactly: a cold
             # PLAN is final (the job never reaches install), a
@@ -590,6 +708,14 @@ def stream_metrics(events: List[dict]) -> List[Family]:
         )
     if warm_counts:
         fams += _warm_families(warm_counts)
+    if (
+        fleet_backends or fleet_routes or fleet_blobs
+        or fleet_failovers
+    ):
+        fams += _fleet_families(
+            fleet_backends, fleet_routes, fleet_route_s,
+            fleet_blobs, fleet_bytes, fleet_failovers, fleet_resub,
+        )
 
     # daemon streams additionally carry the job lifecycle
     from pulsar_tlaplus_tpu.obs import report

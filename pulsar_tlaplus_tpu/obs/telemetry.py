@@ -128,10 +128,15 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # ``widened:AXIS``, or the cold fallback reason — module_edit,
 # invariant_change, binding_change, narrowed, layout_change,
 # digest_mismatch, torn_artifact, ... — docs/incremental.md).
+# v13 (round 20, fleet/): the dispatcher's own stream — one ``route``
+# record per submit placement (which backend, why), one ``replicate``
+# record per artifact sieve pass (what shipped vs what the peer
+# already held), one ``failover`` record per backend drain (how many
+# queued jobs were resubmitted elsewhere).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -213,6 +218,17 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("run_header", "warm"): 12,
     ("warm", "mode"): 12,
     ("warm", "reason"): 12,
+    # v13 (round 20): the fleet dispatcher's events — NEW at v13, so
+    # gating their required fields keeps every committed v12-and-older
+    # stream using these names validator-clean.
+    ("route", "backend"): 13,
+    ("route", "tenant"): 13,
+    ("replicate", "src"): 13,
+    ("replicate", "dst"): 13,
+    ("replicate", "blobs"): 13,
+    ("replicate", "wire_bytes"): 13,
+    ("failover", "backend"): 13,
+    ("failover", "resubmitted"): 13,
     ("admission", "action"): 10,
     ("admission", "tenant"): 10,
     ("auth", "action"): 10,
@@ -327,6 +343,17 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # continue/reseed/cold, ``reason`` the machine-readable cause
     # (sig_match / widened:AXIS / the typed cold-fallback reason)
     "warm": ("mode", "reason"),
+    # fleet tier (r20, fleet/): the DISPATCHER's stream.  ``route`` is
+    # one submit placement — the chosen backend and why (``reason`` in
+    # {sticky, least_loaded, only_backend}); ``replicate`` is one
+    # artifact sieve pass owner->peer — blobs shipped vs reused and
+    # the delta-compressed wire bytes (0 blobs = the peer already held
+    # everything, the sieve's whole point); ``failover`` is one
+    # backend drain — the down backend and how many of its queued jobs
+    # were resubmitted elsewhere through the submit_id dedup path
+    "route": ("backend", "tenant"),
+    "replicate": ("src", "dst", "blobs", "wire_bytes"),
+    "failover": ("backend", "resubmitted"),
 }
 
 

@@ -48,6 +48,15 @@ TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 # localhost — same operator who can read the state dir)
 LOCAL_TENANT = "local"
 
+# the tenant the fleet dispatcher authenticates AS when it talks to
+# its backends (r20, fleet/): the replication verbs (warm_list /
+# warm_offer / warm_pull / warm_push) are fleet-internal — over TCP
+# they answer only this tenant (or trusted unix-socket callers), so
+# an ordinary tenant token can never siphon another tenant's warm
+# artifacts off a backend.  Deployments give the dispatcher its own
+# tokens.json entry under this name.
+FLEET_TENANT = "fleet"
+
 
 def validate_tokens_obj(obj, label: str = "tokens.json") -> List[str]:
     """All shape violations in a parsed tokens object (empty list =

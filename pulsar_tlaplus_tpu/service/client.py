@@ -26,6 +26,11 @@ Resilience (r17):
   client raises :class:`AuthError` (bad token — CLI exit 4) or
   :class:`AdmissionRejected` (over quota / load shed — CLI exit 5)
   so rejected-at-the-door is never confused with daemon-down.
+- **Fleet-aware (r20).**  A dispatcher with no healthy backend
+  answers ``code: backend_unavailable``; the client retries it
+  within the same budget as connect failures (a fleet mid-failover
+  recovers within a health-poll interval) and, exhausted, raises
+  :class:`BackendUnavailable` — transport-class, CLI exit 2, never 1.
 """
 
 from __future__ import annotations
@@ -61,8 +66,20 @@ class TransportError(ServiceError):
     """Transport-level failure that survived every retry (CLI exit 2
     — no verdict, never a spec result)."""
 
+    def __init__(self, msg: str, code: str = "transport"):
+        super().__init__(msg, code=code)
+
+
+class BackendUnavailable(TransportError):
+    """The fleet dispatcher (r20) had no healthy backend to place the
+    request on.  Transport-class, NOT a verdict: the CLI exits 2,
+    never 1.  Unlike the other typed rejections this one is RETRIED
+    within the normal budget first — a fleet mid-failover usually
+    recovers within one health-poll interval, and bouncing a CI
+    pipeline for that window would make every drill a flake."""
+
     def __init__(self, msg: str):
-        super().__init__(msg, code="transport")
+        super().__init__(msg, code="backend_unavailable")
 
 
 # transient errors worth retrying: the daemon restarting
@@ -114,6 +131,8 @@ def _typed_error(resp: dict, op: str) -> ServiceError:
         return AuthError(msg, code=code)
     if code in ("quota", "capacity"):
         return AdmissionRejected(msg, code=code)
+    if code == "backend_unavailable":
+        return BackendUnavailable(msg)
     return ServiceError(msg, code=code)
 
 
@@ -160,8 +179,22 @@ class ServiceClient:
                 time.sleep(delay)
                 continue
             if not resp.get("ok"):
-                raise _typed_error(resp, op)
+                err = _typed_error(resp, op)
+                if isinstance(err, BackendUnavailable):
+                    # a whole-fleet outage is usually one failover
+                    # window wide: spend the retry budget before
+                    # surfacing it
+                    last = err
+                    if delay is None:
+                        break
+                    time.sleep(delay)
+                    continue
+                raise err
             return resp
+        if isinstance(last, BackendUnavailable):
+            raise BackendUnavailable(
+                f"{op!r}: {last} (after {self.retries + 1} attempt(s))"
+            )
         raise TransportError(
             f"{op!r} failed after {self.retries + 1} attempt(s): "
             f"{last!r}"

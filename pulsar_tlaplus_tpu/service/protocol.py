@@ -35,9 +35,15 @@ from typing import Iterator, Optional
 # ``metrics`` (r12) answers a Prometheus text exposition rendered from
 # scheduler state + last-fetched engine stats — a scrape never adds a
 # device sync (docs/observability.md "Flight deck").
+# ``warm_list``/``warm_offer``/``warm_pull``/``warm_push`` (r20,
+# docs/fleet.md) are the fleet replication verbs: the dispatcher
+# sieves a completed job's warm artifact across backends — digests
+# first, only the blobs a peer is missing, each delta-compressed with
+# the r16 plane codec (store/compress.py).
 OPS = (
     "ping", "submit", "status", "result", "cancel", "watch",
     "metrics", "shutdown",
+    "warm_list", "warm_offer", "warm_pull", "warm_push",
 )
 
 # one message must fit memory comfortably; traces are bounded by spec
@@ -182,5 +188,9 @@ def stream(
 def error_response(msg: str, code: str = "bad_request") -> dict:
     """Typed refusal: ``code`` is the machine-readable rejection
     class (``auth`` / ``quota`` / ``capacity`` / ``bad_request`` /
-    ``protocol``) the client maps to its distinct exit code."""
+    ``protocol`` / ``backend_unavailable``) the client maps to its
+    distinct exit code.  ``backend_unavailable`` (r20) is the
+    dispatcher's rejection when no healthy backend can take the
+    request — a TRANSPORT-class failure (client exit 2, retryable
+    with the client's retry budget), never a verification verdict."""
     return {"ok": False, "error": msg, "code": code}

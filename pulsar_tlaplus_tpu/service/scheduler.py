@@ -119,6 +119,13 @@ class ServiceConfig:
     # aot_cache precedent).  0 disables the warm layer entirely —
     # no artifacts harvested, every submit plans cold.
     warm_max_bytes: int = warm_store.DEFAULT_MAX_BYTES
+    # fleet tier (r20, docs/fleet.md): N local device slots — the
+    # scheduler runs up to `devices` jobs concurrently, one worker
+    # thread + warmed checker pool per slot.  1 (the default, and the
+    # only honest value on a single-chip host) is byte-identical to
+    # the classic single-device daemon; N-way is the vertical half of
+    # the fleet story (the dispatcher is the horizontal half).
+    devices: int = 1
     telemetry_path: str = ""  # default: <state_dir>/service.jsonl
 
     def __post_init__(self):
@@ -340,13 +347,14 @@ class CheckerPool:
 
 
 class Scheduler:
-    """FIFO + budget-slice preemption over the checker pool.
+    """FIFO + budget-slice preemption over the checker pool(s).
 
-    Thread model: one scheduler thread runs jobs (one at a time — the
-    whole point is that the single device is time-sliced, not shared);
-    server handler threads call :meth:`submit`/:meth:`cancel`/
+    Thread model: one worker thread per local device slot
+    (``config.devices``, default 1) runs jobs — each slot runs one job
+    at a time, because a device is time-sliced, not shared; server
+    handler threads call :meth:`submit`/:meth:`cancel`/
     :meth:`wait`/:meth:`snapshot` under the internal condition
-    variable.  ``stop()`` suspends the running job at its next level
+    variable.  ``stop()`` suspends every running job at its next level
     boundary (resumable frame on disk), persists the queue, and joins.
     """
 
@@ -359,6 +367,16 @@ class Scheduler:
     ):
         self.config = config
         self.pool = pool or CheckerPool(config)
+        # fleet (r20): one checker pool per local device slot.  Slot 0
+        # IS `self.pool` (so the N=1 daemon — and every pre-fleet test
+        # that injects a shared pool — keeps its exact pool identity);
+        # extra slots get their own pools because a DeviceChecker's
+        # buffers are single-run state and cannot be time-shared by
+        # two concurrently running jobs.
+        n_dev = max(1, int(getattr(config, "devices", 1) or 1))
+        self.pools: List[CheckerPool] = [self.pool] + [
+            CheckerPool(config) for _ in range(n_dev - 1)
+        ]
         self.tel = obs.as_telemetry(telemetry)
         self._log = log or (lambda msg: None)
         self.jobs: Dict[str, Job] = {}
@@ -399,15 +417,41 @@ class Scheduler:
         self._persist_n = 0  # queue.json snapshot sequence (fault site)
         self.persist_failures = 0
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self._running_id: Optional[str] = None
+        self._threads: List[threading.Thread] = []
+        # device slot -> running job_id (r20): one entry per busy
+        # local device.  The single-device daemon's `_running_id`
+        # survives as a slot-0 property below — metrics and the
+        # pre-fleet tests keep reading/writing it unchanged.
+        self._running: Dict[int, str] = {}
         # flight-deck state (r12): the most recent slice's engine stats
-        # + heartbeat snapshot, and the checker actively holding the
-        # device — the `metrics` verb renders from exactly these
+        # + heartbeat snapshot, and the checkers actively holding the
+        # devices — the `metrics` verb renders from exactly these
         # host-side dicts, never a device fetch
         self.last_engine: Optional[dict] = None
-        self._active_ck = None
+        self._active_cks: Dict[int, object] = {}
         os.makedirs(config.jobs_dir, exist_ok=True)
+
+    # compat surface for the pre-fleet single-device daemon: slot 0's
+    # running job / active checker under the old names (obs/metrics.py
+    # and the r17 service tests read — and one test writes — these)
+    @property
+    def _running_id(self) -> Optional[str]:
+        for jid in self._running.values():
+            return jid
+        return None
+
+    @_running_id.setter
+    def _running_id(self, jid: Optional[str]) -> None:
+        if jid is None:
+            self._running.pop(0, None)
+        else:
+            self._running[0] = jid
+
+    @property
+    def _active_ck(self):
+        for ck in self._active_cks.values():
+            return ck
+        return None
 
     # ---------------------------------------------------- persistence
 
@@ -427,7 +471,15 @@ class Scheduler:
                     "version": 1,
                     "jobs": [j.to_dict() for j in self.jobs.values()],
                     "fifo": list(self.fifo),
-                    "running": self._running_id,
+                    # pre-fleet shape: ONE running job (kept so an old
+                    # binary can still read a new daemon's snapshot)
+                    "running": self._running.get(0),
+                    # r20 additive key: every busy device slot's job,
+                    # in slot order — recover() prefers this
+                    "running_devices": [
+                        self._running[d]
+                        for d in sorted(self._running)
+                    ],
                 }
             self._persist_n += 1
             inject = "enospc" in faults.poll(
@@ -514,9 +566,15 @@ class Scheduler:
             order = [
                 jid for jid in snap.get("fifo", []) if jid in self.jobs
             ]
-            interrupted = snap.get("running")
-            if interrupted in self.jobs:
-                order.insert(0, interrupted)
+            interrupted = snap.get("running_devices")
+            if interrupted is None:
+                # pre-r20 snapshot: a single job id (or null)
+                interrupted = snap.get("running")
+            if isinstance(interrupted, str):
+                interrupted = [interrupted]
+            for jid in reversed(interrupted or []):
+                if jid in self.jobs and jid not in order:
+                    order.insert(0, jid)
             n = 0
             for jid in order:
                 job = self.jobs[jid]
@@ -532,7 +590,7 @@ class Scheduler:
                     )
                 self.fifo.append(jid)
                 n += 1
-            self._running_id = None
+            self._running.clear()
             self._reindex_submit_ids()
         self.persist()
         self._log(f"recovered {n} runnable job(s) from queue.json")
@@ -599,7 +657,7 @@ class Scheduler:
                 if not job.terminal:
                     self.fifo.append(job.job_id)
                     n += 1
-            self._running_id = None
+            self._running.clear()
             self._reindex_submit_ids()
         self.persist()
         self._log(
@@ -611,33 +669,46 @@ class Scheduler:
     # -------------------------------------------------------- control
 
     def start(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._loop, name="ptt-scheduler", daemon=True
+        """One worker thread per local device slot (r20).  Slot 0
+        keeps the pre-fleet thread name so ps/log archaeology still
+        finds "ptt-scheduler" on a single-device daemon."""
+        if self._threads:
+            return
+        for d in range(len(self.pools)):
+            t = threading.Thread(
+                target=self._loop,
+                args=(d,),
+                name=(
+                    "ptt-scheduler" if d == 0
+                    else f"ptt-scheduler-{d}"
+                ),
+                daemon=True,
             )
-            self._thread.start()
+            self._threads.append(t)
+            t.start()
 
     def stop(self, timeout: Optional[float] = None) -> None:
-        """Graceful: the running job suspends at its next level
-        boundary (frame on disk), the queue persists, the thread
-        joins."""
+        """Graceful: every running job suspends at its next level
+        boundary (frame on disk), the queue persists, the worker
+        threads join."""
         self._stop.set()
         with self.cv:
             self.cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []
         self.persist()
 
     def run_until_idle(self) -> None:
         """Synchronous drain (in-process harnesses/tests): run slices
-        until no runnable job remains."""
+        until no runnable job remains.  Single-threaded on slot 0 —
+        the drain IS the device."""
         while not self._stop.is_set():
             self._sweep_deadlines()
-            job = self._claim()
+            job = self._claim(0)
             if job is None:
                 return
-            self._run_slice(job)
+            self._run_slice(job, 0)
 
     # --------------------------------------------------------- submit
 
@@ -951,18 +1022,20 @@ class Scheduler:
 
     def idle(self) -> bool:
         with self.cv:
-            return not self.fifo and self._running_id is None
+            return not self.fifo and not self._running
 
     # ------------------------------------------------------- the loop
 
     def _runnable(self) -> bool:
         return bool(self.fifo)
 
-    def _claim(self) -> Optional[Job]:
+    def _claim(self, device: int = 0) -> Optional[Job]:
         """Claim order (r17): highest priority first, FIFO within a
         priority class (the scan is stable — the leftmost of the max
         class wins, and a suspended job re-queued at the tail keeps
-        round-robin fairness within its class)."""
+        round-robin fairness within its class).  ``device`` is the
+        local slot doing the claiming (r20): the job runs on that
+        slot's pool until it finishes or suspends."""
         with self.cv:
             if self._stop.is_set() or not self.fifo:
                 return None
@@ -973,23 +1046,23 @@ class Scheduler:
             )
             self.fifo.remove(jid)
             job = self.jobs[jid]
-            self._running_id = jid
+            self._running[device] = jid
             job.state = jobmod.RUNNING
             if job.started_unix is None:
                 job.started_unix = time.time()
         self.persist()
         return job
 
-    def _loop(self) -> None:
+    def _loop(self, device: int = 0) -> None:
         while not self._stop.is_set():
             self._sweep_deadlines()
-            job = self._claim()
+            job = self._claim(device)
             if job is None:
                 with self.cv:
                     if not self._stop.is_set() and not self.fifo:
                         self.cv.wait(0.25)
                 continue
-            self._run_slice(job)
+            self._run_slice(job, device)
 
     def _other_waiting(self) -> bool:
         with self.cv:
@@ -1018,7 +1091,7 @@ class Scheduler:
                     job.terminal
                     or job.deadline_unix is None
                     or now < job.deadline_unix
-                    or job.job_id == self._running_id
+                    or job.job_id in self._running.values()
                 ):
                     continue
                 try:
@@ -1330,11 +1403,12 @@ class Scheduler:
         hook.resume_emitted = False
         return hook
 
-    def _run_slice(self, job: Job) -> None:
+    def _run_slice(self, job: Job, device: int = 0) -> None:
         from pulsar_tlaplus_tpu.utils import cfg as cfgmod
 
         if job.mode == "simulate":
-            return self._run_sim_slice(job)
+            return self._run_sim_slice(job, device)
+        pool = self.pools[device]
         job.slices += 1
         # resume iff a frame reached disk — even on slice 1: a crashed
         # daemon's mid-first-slice frame (recover() marked the job
@@ -1346,11 +1420,11 @@ class Scheduler:
                 tuple(job.invariants)
                 if job.invariants is not None
                 # pre-resolved-era queue.json: resolve the cfg default
-                else self.pool.resolve_invariants(
+                else pool.resolve_invariants(
                     job.spec, tlc_cfg, None
                 )
             )
-            _key, ck = self.pool.get(
+            _key, ck = pool.get(
                 job.spec, tlc_cfg, invs, job.max_states
             )
         except Exception as e:  # noqa: BLE001 — a bad job must not
@@ -1415,7 +1489,7 @@ class Scheduler:
             resume=resume, ck=ck,
         )
         ck.suspend_hook = hook
-        self._active_ck = ck
+        self._active_cks[device] = ck
         try:
             r = ck.run(seed=warm_seed, resume=resume)
         except Exception as e:  # noqa: BLE001
@@ -1428,7 +1502,7 @@ class Scheduler:
             ck.warm = None
             ck.final_frame = False
             ck.extra_trace_depth = 0
-            self._active_ck = None
+            self._active_cks.pop(device, None)
             # the metrics verb answers from this after the slice ends —
             # plain host dict copies, no device access
             self.last_engine = {
@@ -1466,7 +1540,7 @@ class Scheduler:
             }
             with self.cv:
                 job.state = jobmod.SUSPENDED
-                self._running_id = None
+                self._running.pop(device, None)
                 self.fifo.append(job.job_id)
                 self.cv.notify_all()
             self.persist()
@@ -1514,7 +1588,7 @@ class Scheduler:
             return
         self._complete(job, r, ck=ck)
 
-    def _run_sim_slice(self, job: Job) -> None:
+    def _run_sim_slice(self, job: Job, device: int = 0) -> None:
         """One scheduling slice of a SIMULATION job (r18): the walker
         swarm runs until the slice budget expires and another job
         waits, suspending at a SEGMENT boundary through the same
@@ -1523,6 +1597,7 @@ class Scheduler:
         stream (solo parity pinned in tests/test_sim.py)."""
         from pulsar_tlaplus_tpu.utils import cfg as cfgmod
 
+        pool = self.pools[device]
         job.slices += 1
         resume = os.path.exists(job.frame_path)
         try:
@@ -1530,11 +1605,11 @@ class Scheduler:
             invs = (
                 tuple(job.invariants)
                 if job.invariants is not None
-                else self.pool.resolve_invariants(
+                else pool.resolve_invariants(
                     job.spec, tlc_cfg, None
                 )
             )
-            _key, eng = self.pool.get_sim(
+            _key, eng = pool.get_sim(
                 job.spec, tlc_cfg, invs, job.sim or {}
             )
         except Exception as e:  # noqa: BLE001 — a bad job must not
@@ -1566,7 +1641,7 @@ class Scheduler:
             resume=resume, ck=eng,
         )
         eng.suspend_hook = hook
-        self._active_ck = eng
+        self._active_cks[device] = eng
         try:
             r = eng.run(resume=resume)
         except Exception as e:  # noqa: BLE001
@@ -1574,7 +1649,7 @@ class Scheduler:
             return
         finally:
             eng.suspend_hook = None
-            self._active_ck = None
+            self._active_cks.pop(device, None)
             self.last_engine = {
                 "job_id": job.job_id,
                 "spec": job.spec,
@@ -1599,7 +1674,7 @@ class Scheduler:
             }
             with self.cv:
                 job.state = jobmod.SUSPENDED
-                self._running_id = None
+                self._running.pop(device, None)
                 self.fifo.append(job.job_id)
                 self.cv.notify_all()
             self.persist()
@@ -1812,8 +1887,9 @@ class Scheduler:
             return
         job.state = state
         job.finished_unix = time.time()
-        if self._running_id == job.job_id:
-            self._running_id = None
+        for d, jid in list(self._running.items()):
+            if jid == job.job_id:
+                del self._running[d]
         # the frame is dead weight once the job is terminal
         if state != jobmod.SUSPENDED:
             try:

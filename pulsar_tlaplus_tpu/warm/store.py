@@ -35,6 +35,8 @@ Robustness discipline (the r7/r9 treatment, docs/robustness.md):
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import hashlib
 import json
 import os
@@ -102,10 +104,41 @@ class WarmStore:
         self.root = root
         self.max_bytes = int(max_bytes)
         self._log = log or (lambda msg: None)
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._write_n = 0  # warmwrite fault-site counter
         self._verify_n = 0  # warm fault-site counter
         os.makedirs(root, exist_ok=True)
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """Store-wide writer mutex.  Replication made the warm dir
+        genuinely multi-writer (a peer push installing an artifact,
+        this daemon's post-run harvest, and the LRU cap can all run at
+        once), and the pre-fleet code only serialized the fault-site
+        counters: ``save()`` could be mid-frame-write while
+        ``enforce_cap()`` rmtree'd the same dir out from under it, and
+        two saves for one sig could interleave writer A's frame with
+        writer B's manifest (digest mismatch -> a good artifact
+        quarantined).  The thread lock serializes THIS process; the
+        flock on ``<root>/.lock`` serializes processes and is
+        kernel-released on any death, so a crashed writer never wedges
+        the store (the r11 ``ckpt.save_frame`` discipline at dir
+        scope)."""
+        with self._lock:
+            fd = os.open(
+                os.path.join(self.root, ".lock"),
+                os.O_CREAT | os.O_RDWR,
+                0o644,
+            )
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                yield
+            finally:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                os.close(fd)
 
     # ------------------------------------------------------------ paths
 
@@ -125,6 +158,7 @@ class WarmStore:
             os.path.join(self.root, n)
             for n in names
             if n != "quarantine"
+            and not n.startswith(".")  # .lock / .stage.* writer tmp
             and os.path.isdir(os.path.join(self.root, n))
         ]
 
@@ -153,72 +187,154 @@ class WarmStore:
             self._write_n += 1
             n = self._write_n
         try:
-            os.makedirs(adir, exist_ok=True)
-            files: Dict[str, Dict[str, object]] = {}
-            nbytes = _copy_atomic(
-                frame_path, os.path.join(adir, FRAME)
-            )
-            files[FRAME] = {
-                "sha256": file_sha256(os.path.join(adir, FRAME)),
-                "bytes": nbytes,
-            }
-            spill_src = f"{frame_path}.spill"
-            spill_dst = os.path.join(adir, f"{FRAME}.spill")
-            if os.path.isdir(spill_src):
-                os.makedirs(spill_dst, exist_ok=True)
-                for name in sorted(os.listdir(spill_src)):
-                    src = os.path.join(spill_src, name)
-                    if not os.path.isfile(src):
-                        continue
-                    rel = f"{FRAME}.spill/{name}"
-                    files[rel] = {
-                        "sha256": file_sha256(src),
-                        "bytes": _copy_atomic(
-                            src, os.path.join(spill_dst, name)
-                        ),
-                    }
-            elif os.path.isdir(spill_dst):
-                # the previous artifact for this sig spilled, this run
-                # did not: stale cold runs must not survive under the
-                # new manifest
-                shutil.rmtree(spill_dst, ignore_errors=True)
-            man = dict(manifest)
-            man["warm_v"] = WARM_VERSION
-            man["files"] = files
-            man["bytes"] = sum(int(f["bytes"]) for f in files.values())
-            man["created_unix"] = round(time.time(), 3)
-            mpath = os.path.join(adir, MANIFEST)
-            blob = json.dumps(man, sort_keys=True)
-            # the fault site sits BETWEEN the frame write and the
-            # manifest publish: kill here is the mid-warm-write drill
-            # (manifest-less dir -> sweep quarantine), torn publishes
-            # half a manifest (digest/parse failure -> quarantine)
-            kinds = faults.poll("warmwrite", n)
-            if "torn" in kinds:
-                with open(mpath, "w") as f:
-                    f.write(blob[: max(1, len(blob) // 2)])
-                raise OSError(
-                    f"injected fault torn@warmwrite:{n} (PTT_FAULT)"
+            with self._locked():
+                return self._save_locked(
+                    frame_path, manifest, sig, adir, n
                 )
-            tmp = f"{mpath}.tmp.{os.getpid()}.{threading.get_ident()}"
-            try:
-                with open(tmp, "w") as f:
-                    f.write(blob)
-                os.replace(tmp, mpath)
-            except BaseException:
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
-                raise
         except OSError as e:
             self._log(
                 f"warm: artifact save FAILED for {sig_key(sig)} "
                 f"({e!r:.120}); the run's result is unaffected"
             )
             return None
-        self.enforce_cap()
+
+    def _save_locked(
+        self, frame_path: str, manifest, sig: str, adir: str, n: int
+    ) -> str:
+        os.makedirs(adir, exist_ok=True)
+        files: Dict[str, Dict[str, object]] = {}
+        nbytes = _copy_atomic(
+            frame_path, os.path.join(adir, FRAME)
+        )
+        files[FRAME] = {
+            "sha256": file_sha256(os.path.join(adir, FRAME)),
+            "bytes": nbytes,
+        }
+        spill_src = f"{frame_path}.spill"
+        spill_dst = os.path.join(adir, f"{FRAME}.spill")
+        if os.path.isdir(spill_src):
+            os.makedirs(spill_dst, exist_ok=True)
+            for name in sorted(os.listdir(spill_src)):
+                src = os.path.join(spill_src, name)
+                if not os.path.isfile(src):
+                    continue
+                rel = f"{FRAME}.spill/{name}"
+                files[rel] = {
+                    "sha256": file_sha256(src),
+                    "bytes": _copy_atomic(
+                        src, os.path.join(spill_dst, name)
+                    ),
+                }
+        elif os.path.isdir(spill_dst):
+            # the previous artifact for this sig spilled, this run
+            # did not: stale cold runs must not survive under the
+            # new manifest
+            shutil.rmtree(spill_dst, ignore_errors=True)
+        man = dict(manifest)
+        man["warm_v"] = WARM_VERSION
+        man["files"] = files
+        man["bytes"] = sum(int(f["bytes"]) for f in files.values())
+        man["created_unix"] = round(time.time(), 3)
+        mpath = os.path.join(adir, MANIFEST)
+        blob = json.dumps(man, sort_keys=True)
+        # the fault site sits BETWEEN the frame write and the
+        # manifest publish: kill here is the mid-warm-write drill
+        # (manifest-less dir -> sweep quarantine), torn publishes
+        # half a manifest (digest/parse failure -> quarantine)
+        kinds = faults.poll("warmwrite", n)
+        if "torn" in kinds:
+            with open(mpath, "w") as f:
+                f.write(blob[: max(1, len(blob) // 2)])
+            raise OSError(
+                f"injected fault torn@warmwrite:{n} (PTT_FAULT)"
+            )
+        tmp = f"{mpath}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, mpath)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        self._enforce_cap_locked()
         return adir
+
+    # ---------------------------------------------------------- install
+
+    def install(
+        self,
+        manifest: Dict[str, object],
+        blobs: Dict[str, bytes],
+        reuse_from: Optional[str] = None,
+    ) -> Tuple[Optional[str], str]:
+        """Install a REPLICATED artifact: ``manifest`` is the owning
+        daemon's published manifest verbatim (its ``files`` digests
+        are the contract), ``blobs`` maps the rels the sieve shipped
+        to their decoded bytes, and rels listed in the manifest but
+        absent from ``blobs`` are reused from ``reuse_from`` (this
+        store's existing artifact for the same sig — the "peer
+        already holds these" half of the handshake).  The artifact is
+        staged fully, digest-verified byte-for-byte against the
+        manifest BEFORE publication, then swapped in atomically under
+        the store lock.  Returns ``(adir, "ok")`` or
+        ``(None, reason)`` — a bad push never replaces a good
+        artifact."""
+        try:
+            files = manifest["files"]
+            sig = str(manifest["config_sig"])
+        except (KeyError, TypeError):
+            return None, "bad_manifest: missing files/config_sig"
+        if not isinstance(files, dict) or FRAME not in files:
+            return None, "bad_manifest: manifest lists no frame"
+        adir = self.dir_for(sig)
+        stage = os.path.join(
+            self.root,
+            f".stage.{os.getpid()}.{threading.get_ident()}."
+            f"{sig_key(sig)}",
+        )
+        try:
+            shutil.rmtree(stage, ignore_errors=True)
+            os.makedirs(stage)
+            for rel, meta in sorted(files.items()):
+                # rels come off the wire: confine them to the stage
+                dst = os.path.join(stage, rel)
+                if not os.path.realpath(dst).startswith(
+                    os.path.realpath(stage) + os.sep
+                ):
+                    return None, f"bad_manifest: unsafe rel {rel!r}"
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                if rel in blobs:
+                    with open(dst, "wb") as f:
+                        f.write(blobs[rel])
+                elif reuse_from:
+                    src = os.path.join(reuse_from, rel)
+                    if not os.path.isfile(src):
+                        return None, f"missing_blob: {rel}"
+                    shutil.copyfile(src, dst)
+                else:
+                    return None, f"missing_blob: {rel}"
+                got = file_sha256(dst)
+                if got != meta.get("sha256"):
+                    return None, f"digest_mismatch: {rel}"
+                if os.path.getsize(dst) != meta.get("bytes"):
+                    return None, f"byte_mismatch: {rel}"
+            mpath = os.path.join(stage, MANIFEST)
+            tmp = f"{mpath}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(manifest, sort_keys=True))
+            os.replace(tmp, mpath)
+            with self._locked():
+                shutil.rmtree(adir, ignore_errors=True)
+                os.replace(stage, adir)
+                self._enforce_cap_locked()
+            return adir, "ok"
+        except OSError as e:
+            return None, f"install_failed: {e!r:.120}"
+        finally:
+            shutil.rmtree(stage, ignore_errors=True)
 
     # ------------------------------------------------------------- read
 
@@ -320,12 +436,39 @@ class WarmStore:
         """Startup hygiene: every artifact that fails verification —
         torn manifest, missing file, digest mismatch, version skew —
         is moved to ``quarantine/`` (kept for forensics, never
-        reused).  Returns the quarantined reasons."""
+        reused).  Returns the quarantined reasons.  Runs under the
+        store lock: a concurrent writer mid-save would otherwise look
+        exactly like a torn artifact and get quarantined while live."""
         quarantined: List[str] = []
-        for adir in self._entries():
-            ok, reason = self.verify(adir)
-            if ok:
-                continue
+        with self._locked():
+            for adir in self._entries():
+                ok, reason = self.verify(adir)
+                if ok:
+                    continue
+                os.makedirs(self.quarantine_dir, exist_ok=True)
+                dst = os.path.join(
+                    self.quarantine_dir,
+                    f"{os.path.basename(adir)}."
+                    f"{int(time.time() * 1000)}",
+                )
+                try:
+                    os.replace(adir, dst)
+                except OSError:
+                    shutil.rmtree(adir, ignore_errors=True)
+                    dst = "<removed>"
+                quarantined.append(
+                    f"{os.path.basename(adir)}: {reason}"
+                )
+                self._log(
+                    f"warm: quarantined unverifiable artifact "
+                    f"{os.path.basename(adir)} ({reason}) -> {dst}"
+                )
+        return quarantined
+
+    def quarantine(self, adir: str, reason: str) -> None:
+        """Move one artifact aside after a failed install-time verify
+        (the corrupt@warm drill path)."""
+        with self._locked():
             os.makedirs(self.quarantine_dir, exist_ok=True)
             dst = os.path.join(
                 self.quarantine_dir,
@@ -335,26 +478,6 @@ class WarmStore:
                 os.replace(adir, dst)
             except OSError:
                 shutil.rmtree(adir, ignore_errors=True)
-                dst = "<removed>"
-            quarantined.append(f"{os.path.basename(adir)}: {reason}")
-            self._log(
-                f"warm: quarantined unverifiable artifact "
-                f"{os.path.basename(adir)} ({reason}) -> {dst}"
-            )
-        return quarantined
-
-    def quarantine(self, adir: str, reason: str) -> None:
-        """Move one artifact aside after a failed install-time verify
-        (the corrupt@warm drill path)."""
-        os.makedirs(self.quarantine_dir, exist_ok=True)
-        dst = os.path.join(
-            self.quarantine_dir,
-            f"{os.path.basename(adir)}.{int(time.time() * 1000)}",
-        )
-        try:
-            os.replace(adir, dst)
-        except OSError:
-            shutil.rmtree(adir, ignore_errors=True)
         self._log(
             f"warm: quarantined {os.path.basename(adir)} ({reason})"
         )
@@ -376,7 +499,15 @@ class WarmStore:
         """Evict oldest-touched artifacts past ``max_bytes`` (mtime
         LRU, the aot_cache discipline).  0 disables the store rather
         than the cap — the scheduler never constructs one then.
-        Returns the number evicted."""
+        Returns the number evicted.  Takes the store lock: evicting
+        while another writer is mid-save would rmtree a dir that
+        writer is still filling."""
+        if self.max_bytes <= 0:
+            return 0
+        with self._locked():
+            return self._enforce_cap_locked()
+
+    def _enforce_cap_locked(self) -> int:
         if self.max_bytes <= 0:
             return 0
         entries = []

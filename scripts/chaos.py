@@ -504,6 +504,271 @@ def run_chaos(
     return report
 
 
+def run_fleet_chaos(
+    state_dir: str,
+    seed: int = 0,
+    slice_s: float = 2.0,
+    timeout_s: float = 600.0,
+    geom: Optional[dict] = None,
+    solo=None,
+    pool=None,
+    log=lambda m: print(f"chaos: {m}", file=sys.stderr, flush=True),
+) -> dict:
+    """The fleet drill (ISSUE 16, ``--fleet``): two backends behind a
+    dispatcher; a truncated job's warm artifact replicates to the
+    peer; the owning backend is killed mid-job; the widened resubmit
+    lands on the SURVIVOR, warm-starts from the REPLICATED artifact,
+    and finishes state-for-state equal to an uninterrupted solo run.
+    A job queued (not running) on the dead backend is resubmitted by
+    the dispatcher itself through ``submit_id`` dedup and must also
+    land the solo-exact result; the job RUNNING at the kill is marked
+    ``lost`` (never silently resubmitted — docs/fleet.md Failover).
+    Raises :class:`ChaosFailure` on any broken invariant."""
+    from pulsar_tlaplus_tpu.fleet.dispatcher import (
+        FleetConfig,
+        FleetDispatcher,
+    )
+    from pulsar_tlaplus_tpu.service.client import ServiceError
+    from pulsar_tlaplus_tpu.service.scheduler import (
+        CheckerPool,
+        ServiceConfig,
+    )
+    from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+
+    geom = dict(geom or GEOM_FAST)
+    os.makedirs(state_dir, exist_ok=True)
+    cfg_dir = os.path.join(state_dir, "cfgs")
+    os.makedirs(cfg_dir, exist_ok=True)
+    comp_cfg = os.path.join(cfg_dir, "small_compaction.cfg")
+    with open(comp_cfg, "w") as f:
+        f.write(SMALL_COMPACTION_CFG)
+
+    report: dict = {"seed": seed}
+    configs = [
+        ServiceConfig(
+            state_dir=os.path.join(state_dir, f"backend{i}"),
+            slice_s=slice_s,
+            **geom,
+        )
+        for i in range(2)
+    ]
+    pool0 = pool or CheckerPool(configs[0])
+    if solo is None:
+        log("computing the solo baseline (pre-fleet, same geometry)")
+        solo = _solo_results(
+            pool0, {"compaction": ("compaction", comp_cfg)}
+        )["compaction"]
+
+    daemons = [
+        ServiceDaemon(
+            configs[0], pool=pool0,
+            log=lambda m: log(f"[backend0] {m}"),
+        ),
+        ServiceDaemon(
+            configs[1], log=lambda m: log(f"[backend1] {m}"),
+        ),
+    ]
+    disp = None
+    try:
+        for d in daemons:
+            d.start()
+        addrs = tuple(c.socket_path for c in configs)
+        disp = FleetDispatcher(
+            FleetConfig(
+                state_dir=os.path.join(state_dir, "dispatch"),
+                backends=addrs,
+                health_interval_s=0.2,
+                fail_after=2,
+                backend_timeout_s=5.0,
+            ),
+            log=lambda m: log(f"[dispatch] {m}"),
+        )
+        disp.start()
+        cl = ServiceClient(
+            disp.config.socket_path, timeout=timeout_s, retries=8,
+            rng=random.Random(seed ^ 0xF1EE7),
+        )
+
+        # --- 1. truncated probe through the dispatcher -------------
+        rt_sub = cl.submit(
+            "compaction", comp_cfg, max_states=600,
+            submit_id="fleet-trunc", full=True,
+        )
+        owner = rt_sub["backend"]
+        survivor = next(a for a in addrs if a != owner)
+        jt = rt_sub["job_id"]
+        rt = cl.wait(jt, timeout=timeout_s)
+        if (rt.get("result") or {}).get("status") != "truncated":
+            raise ChaosFailure(
+                f"truncation probe ended {rt.get('result')!r} "
+                "(wanted status=truncated)"
+            )
+        report["owner"] = owner
+        log(f"truncated probe done on {owner}")
+
+        # --- 2. the artifact replicates to the peer ----------------
+        peer_daemon = daemons[addrs.index(survivor)]
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            ws = peer_daemon.sched.warm_store
+            if ws is not None and ws.manifests():
+                break
+            time.sleep(0.2)
+        else:
+            raise ChaosFailure(
+                f"warm artifact never replicated {owner} -> {survivor}"
+            )
+        repl = disp.metrics_snapshot()
+        report["replicated_wire_bytes"] = sum(
+            repl["repl_bytes"].values()
+        )
+        log(
+            f"artifact replicated to {survivor} "
+            f"({report['replicated_wire_bytes']} wire bytes)"
+        )
+
+        # --- 3. pin the owner busy + queue one more behind ---------
+        # a long simulation job occupies the owner's only device slot
+        # (sticky routing keeps the tenant there), so the next check
+        # job is deterministically QUEUED when the kill lands
+        js = cl.submit(
+            "compaction", comp_cfg, mode="simulate",
+            sim=dict(
+                n_walkers=64, depth=32, segment_len=8,
+                max_steps=1 << 22, seed=seed,
+            ),
+            warm=False, submit_id="fleet-sim",
+        )
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if cl.status(js).get("state") == "running":
+                break
+            time.sleep(0.1)
+        else:
+            raise ChaosFailure("sim job never started on the owner")
+        jq_sub = cl.submit(
+            "compaction", comp_cfg, warm=False,
+            submit_id="fleet-queued", full=True,
+        )
+        jq = jq_sub["job_id"]
+        if jq_sub["backend"] != owner:
+            raise ChaosFailure(
+                f"queued probe routed to {jq_sub['backend']}, not the "
+                f"sticky owner {owner} (stickiness broken)"
+            )
+        if cl.status(jq).get("state") != "queued":
+            raise ChaosFailure("queued probe was not queued")
+
+        # --- 4. kill the owner mid-job -----------------------------
+        log(f"killing {owner} (sim running, one job queued)")
+        daemons[addrs.index(owner)].shutdown()
+
+        # --- 5. the dispatcher drains it and fails over ------------
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            snap = disp.metrics_snapshot()
+            if snap["failovers"].get(owner):
+                break
+            time.sleep(0.2)
+        else:
+            raise ChaosFailure(f"{owner} was never drained/failed over")
+        report["resubmitted"] = int(
+            disp.metrics_snapshot()["resubmitted"].get(owner, 0)
+        )
+        if report["resubmitted"] != 1:
+            raise ChaosFailure(
+                f"expected exactly the queued job resubmitted, got "
+                f"{report['resubmitted']}"
+            )
+
+        # --- 6. widened resubmit lands warm on the survivor --------
+        rw_sub = cl.submit(
+            "compaction", comp_cfg, submit_id="fleet-widened",
+            full=True,
+        )
+        if rw_sub["backend"] != survivor:
+            raise ChaosFailure(
+                f"widened resubmit routed to {rw_sub['backend']}, "
+                f"not the survivor {survivor}"
+            )
+        rw = cl.wait(rw_sub["job_id"], timeout=timeout_s)
+        if rw.get("state") != "done" or not rw.get("result"):
+            raise ChaosFailure(
+                f"widened resubmit ended {rw.get('state')}: "
+                f"{rw.get('error')}"
+            )
+        if rw["result"].get("warm") not in ("continue", "reseed"):
+            raise ChaosFailure(
+                "widened resubmit did not warm-start from the "
+                "replicated artifact "
+                f"(warm={rw['result'].get('warm')!r} "
+                f"reason={rw['result'].get('warm_reason')!r})"
+            )
+        _assert_parity(
+            rw["result"], solo, f"fleet-widened/{rw_sub['job_id']}"
+        )
+        report["warm_mode"] = rw["result"]["warm"]
+        log(
+            f"widened resubmit warm-started on the survivor "
+            f"(warm={report['warm_mode']}) and matched solo exactly"
+        )
+
+        # --- 7. the failed-over queued job is solo-exact too -------
+        rq = cl.wait(jq, timeout=timeout_s)
+        if rq.get("state") != "done" or not rq.get("result"):
+            raise ChaosFailure(
+                f"failed-over job ended {rq.get('state')}: "
+                f"{rq.get('error')}"
+            )
+        _assert_parity(rq["result"], solo, f"fleet-queued/{jq}")
+
+        # --- 8. the running job is LOST, loudly --------------------
+        table = {j["job_id"]: j for j in cl.status()}
+        if table.get(js, {}).get("state") != "lost":
+            raise ChaosFailure(
+                f"the job running at the kill should be 'lost', got "
+                f"{table.get(js)!r}"
+            )
+        try:
+            cl.result(js)
+            raise ChaosFailure("result on a lost job did not fail")
+        except ServiceError as e:
+            if "lost" not in str(e):
+                raise ChaosFailure(
+                    f"lost-job result error is untyped: {e}"
+                ) from e
+
+        # --- 9. fleet telemetry + metrics validator-clean ----------
+        metrics_text = cl.metrics()
+        for needle in (
+            "ptt_fleet_backends",
+            "ptt_fleet_routes_total",
+            "ptt_fleet_replicated_wire_bytes_total",
+            "ptt_fleet_failovers_total",
+        ):
+            if needle not in metrics_text:
+                raise ChaosFailure(f"{needle} missing from metrics")
+    finally:
+        if disp is not None:
+            disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+
+    stream_errors = _validate_streams(
+        [disp.config.telemetry_path]
+        + [c.telemetry_path for c in configs]
+    )
+    if stream_errors:
+        raise ChaosFailure(f"stream violations: {stream_errors}")
+    report["streams_validated"] = 3
+    log(
+        "PASS: replication + failover + warm resubmit all solo-exact "
+        f"({report['replicated_wire_bytes']} wire bytes replicated, "
+        f"{report['resubmitted']} job(s) failed over)"
+    )
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="service-layer chaos drill (seeded, reproducible)"
@@ -521,6 +786,13 @@ def main(argv=None) -> int:
     ap.add_argument("--clients", type=int, default=2)
     ap.add_argument("--jobs-per-client", type=int, default=2)
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="run the fleet drill instead: two backends behind a "
+        "dispatcher — warm replication, a mid-job backend kill, "
+        "failover resubmit, and a solo-exact warm restart on the "
+        "survivor (docs/fleet.md)",
+    )
     args = ap.parse_args(argv)
     state_dir = args.state_dir
     if state_dir is None:
@@ -528,14 +800,19 @@ def main(argv=None) -> int:
 
         state_dir = tempfile.mkdtemp(prefix="ptt_chaos_")
     try:
-        run_chaos(
-            state_dir,
-            seed=args.seed,
-            schedule=args.schedule,
-            clients=args.clients,
-            jobs_per_client=args.jobs_per_client,
-            timeout_s=args.timeout,
-        )
+        if args.fleet:
+            run_fleet_chaos(
+                state_dir, seed=args.seed, timeout_s=args.timeout
+            )
+        else:
+            run_chaos(
+                state_dir,
+                seed=args.seed,
+                schedule=args.schedule,
+                clients=args.clients,
+                jobs_per_client=args.jobs_per_client,
+                timeout_s=args.timeout,
+            )
     except ChaosFailure as e:
         print(f"chaos: FAIL: {e}", file=sys.stderr)
         return 1

@@ -82,7 +82,13 @@ the tiered-store keys (``hbm_budget``, ``spill_bytes_per_state``,
 ``spill_overlap_ratio`` — null on untiered runs, keys required),
 >= 9 additionally the swarm-simulation throughput keys
 (``walks_per_sec``, ``steps_per_state`` — null on check-mode runs,
-keys required).
+keys required), >= 10 additionally the fleet-tier keys
+(``fleet_backends``, ``fleet_jobs_per_sec``, ``fleet_route_ms``,
+``fleet_replicated_wire_bytes`` — null on non-fleet runs, keys
+required).  r20: v13 streams additionally validate the dispatcher's
+``route``/``replicate``/``failover`` events (FIELD_SINCE-gated) and
+the ``ptt_fleet_*`` families render identically from the live
+dispatcher and a stream scrape.
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -141,6 +147,15 @@ BENCH_KEYS_V8 = BENCH_KEYS_V7 + (
 # v9 (r18): the swarm-simulation throughput signals (null on
 # check-mode runs; the keys themselves are required)
 BENCH_KEYS_V9 = BENCH_KEYS_V8 + ("walks_per_sec", "steps_per_state")
+# v10 (r20): the fleet-tier signals from `bench.py --fleet N` — how
+# many backends served, end-to-end queue throughput through the
+# dispatcher, mean route (placement) latency, and the replication
+# sieve's total delta-compressed wire bytes (null on non-fleet runs;
+# the keys themselves are required)
+BENCH_KEYS_V10 = BENCH_KEYS_V9 + (
+    "fleet_backends", "fleet_jobs_per_sec", "fleet_route_ms",
+    "fleet_replicated_wire_bytes",
+)
 
 
 def _check_fused_levels(path: str, runs: dict) -> List[str]:
@@ -370,7 +385,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 9:
+    if schema >= 10:
+        required = BENCH_KEYS_V10
+    elif schema >= 9:
         required = BENCH_KEYS_V9
     elif schema >= 8:
         required = BENCH_KEYS_V8

@@ -1,0 +1,477 @@
+"""Fleet-tier tests (ISSUE 16, ``pulsar_tlaplus_tpu/fleet/``).
+
+The acceptance bar (docs/fleet.md):
+
+- a 2-backend fleet behind one dispatcher routes submits by live
+  signal (sticky where warm locality pays), every result
+  state-for-state equal to a solo run of the same spec + .cfg;
+- a truncated job's warm artifact replicates to the NON-owning
+  backend via the sieve handshake, and a widened submit landing there
+  warm-continues from the replicated artifact;
+- the failover drill (scripts/chaos.py ``--fleet``): the owning
+  backend killed mid-job, its queued job resubmitted elsewhere
+  through ``submit_id`` dedup, the running job marked ``lost``, and
+  the widened resubmit solo-exact on the survivor;
+- a warm submit THROUGH the dispatcher pays zero jit compiles — the
+  routing hop must not cost a recompile;
+- the warm store survives hammering concurrent writers (the
+  fleet-era multi-writer mix: saves, peer-push installs, LRU cap).
+
+The slow-marked load test runs a 3-backend mixed-spec batch and emits
+a bench_schema-10 fleet artifact the validator and ledger accept.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from pulsar_tlaplus_tpu.fleet.dispatcher import (
+    FleetConfig,
+    FleetDispatcher,
+)
+from pulsar_tlaplus_tpu.service import jobs as jobmod
+from pulsar_tlaplus_tpu.service.client import ServiceClient, ServiceError
+from pulsar_tlaplus_tpu.service.scheduler import CheckerPool
+from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+from pulsar_tlaplus_tpu.warm import store as warmstore
+
+# the service-layer harness is the contract here too: same geometry,
+# same cfg bindings, same solo baselines, same parity assertion
+from tests.test_service import (  # noqa: F401  (fixtures by name)
+    BK_CFG,
+    GEOM,
+    _config,
+    _load_script,
+    assert_result_matches_solo,
+    cfg_dir,
+    checker_mod,
+    pool,
+    solo_bk_crash2,
+    solo_compaction,
+)
+
+
+class _Result:
+    """Adapter: assert_result_matches_solo wants a job-shaped object
+    with ``.result``/``.state``/``.error`` — wire replies are dicts."""
+
+    def __init__(self, reply):
+        self.result = reply.get("result")
+        self.state = reply.get("state")
+        self.error = reply.get("error")
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory, pool):
+    """One 2-backend fleet for the module: backend0 holds the shared
+    module pool (the warmed one), backend1 compiles its own — exactly
+    the heterogeneous-warmth shape routing must handle."""
+    root = tmp_path_factory.mktemp("fleet")
+    configs = [
+        _config(root / "b0", slice_s=0.3),
+        _config(root / "b1", slice_s=0.3),
+    ]
+    daemons = [
+        ServiceDaemon(configs[0], pool=pool),
+        ServiceDaemon(configs[1]),
+    ]
+    for d in daemons:
+        d.start()
+    fc = FleetConfig(
+        state_dir=str(root / "disp"),
+        backends=tuple(c.socket_path for c in configs),
+        health_interval_s=0.2,
+        fail_after=2,
+        backend_timeout_s=5.0,
+    )
+    disp = FleetDispatcher(fc)
+    disp.start()
+    cl = ServiceClient(fc.socket_path, timeout=240.0)
+    state = dict(
+        daemons=daemons, configs=configs, disp=disp, client=cl,
+        addrs=[c.socket_path for c in configs],
+    )
+    try:
+        yield state
+    finally:
+        disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+
+
+# ---- 2-backend routing smoke (tier-1 acceptance) --------------------
+
+
+def test_fleet_routing_smoke_solo_parity(
+    fleet, cfg_dir, solo_compaction, solo_bk_crash2
+):
+    """Two specs through ONE dispatcher endpoint: every reply carries
+    the chosen backend, the routing table scopes listings, and both
+    results are state-for-state solo-exact — the hop through the
+    dispatcher must be invisible to the verdict."""
+    cl = fleet["client"]
+    pong = cl.ping()
+    assert pong["fleet"] is True
+    assert set(pong["backends"]) == set(fleet["addrs"])
+    assert all(s == "up" for s in pong["backends"].values())
+
+    r1 = cl.submit(
+        "compaction", str(cfg_dir / "small_compaction.cfg"),
+        invariants=[], full=True,
+    )
+    r2 = cl.submit(
+        "bookkeeper", str(cfg_dir / "bk_crash2.cfg"), full=True,
+    )
+    assert r1["backend"] in fleet["addrs"]
+    assert r2["backend"] in fleet["addrs"]
+
+    w1 = cl.wait(r1["job_id"], timeout=600.0)
+    w2 = cl.wait(r2["job_id"], timeout=600.0)
+    assert w1["state"] == jobmod.DONE
+    assert w2["state"] == jobmod.DONE
+    assert_result_matches_solo(_Result(w1), solo_compaction)
+    assert_result_matches_solo(_Result(w2), solo_bk_crash2)
+    # result replies are proxied — they name the owning backend too
+    assert w1["backend"] == r1["backend"]
+    assert w2["backend"] == r2["backend"]
+
+    # the dispatcher's listing comes from its OWN routing table
+    jobs = {j["job_id"]: j for j in cl.status()}
+    assert {r1["job_id"], r2["job_id"]} <= set(jobs)
+    assert jobs[r1["job_id"]]["backend"] == r1["backend"]
+    assert jobs[r1["job_id"]]["state"] == jobmod.DONE
+
+    # routing decisions surfaced as ptt_fleet_* metrics
+    snap = fleet["disp"].metrics_snapshot()
+    reasons = {why for (_a, why) in snap["routes"]}
+    assert reasons <= {"sticky", "least_loaded", "only_backend"}
+    assert sum(snap["routes"].values()) >= 2
+    text = cl.metrics()
+    assert "ptt_fleet_backends" in text
+    assert "ptt_fleet_routes_total" in text
+
+    # errors proxy typed: a bad spec fails eagerly through the hop
+    with pytest.raises(ServiceError, match="not in the compiled"):
+        cl.submit("no_such_spec", str(cfg_dir / "bk_crash2.cfg"))
+    with pytest.raises(ServiceError, match="not routed through"):
+        cl.status("nope")
+
+
+# ---- warm replication: the hit lands on the NON-owning backend ------
+
+
+def test_fleet_replicates_warm_artifact_to_peer(
+    fleet, cfg_dir, solo_compaction
+):
+    """A truncated probe's artifact must cross the fleet via the sieve
+    so a widened submit landing on the OTHER backend warm-continues
+    from the replicated frame — warm locality without ownership."""
+    cl = fleet["client"]
+    probe = cl.submit(
+        "compaction", str(cfg_dir / "small_compaction.cfg"),
+        invariants=[], max_states=600,
+        submit_id="fleet-repl-probe", full=True,
+    )
+    owner = probe["backend"]
+    peer_i = 1 - fleet["addrs"].index(owner)
+    peer_daemon = fleet["daemons"][peer_i]
+    done = cl.wait(probe["job_id"], timeout=600.0)
+    assert done["result"]["status"] == "truncated"
+
+    # the health thread notices the terminal job and replicates; the
+    # peer's OWN store must end up holding the truncated artifact
+    deadline = time.monotonic() + 120.0
+    man = None
+    while man is None:
+        for _adir, m in peer_daemon.sched.warm_store.manifests():
+            if m.get("spec") == "compaction" and m.get("truncated"):
+                man = m
+        if man is None:
+            assert time.monotonic() < deadline, (
+                "replication never reached the peer store"
+            )
+            time.sleep(0.1)
+    snap = fleet["disp"].metrics_snapshot()
+    assert sum(snap["repl_blobs"].values()) >= 1
+    assert sum(snap["repl_bytes"].values()) >= 1
+
+    # widened submit sent DIRECTLY to the peer (bypassing routing
+    # stickiness): it never owned the probe, so a warm start here is
+    # proof the replicated artifact is genuinely usable
+    peer_cl = ServiceClient(
+        fleet["configs"][peer_i].socket_path, timeout=240.0
+    )
+    wide = peer_cl.submit(
+        "compaction", str(cfg_dir / "small_compaction.cfg"),
+        invariants=[], full=True,
+    )
+    w = peer_cl.wait(wide["job_id"], timeout=600.0)
+    assert w["state"] == jobmod.DONE
+    assert w["result"]["warm"] in ("continue", "reseed")
+    assert_result_matches_solo(_Result(w), solo_compaction)
+
+
+# ---- failover: the chaos drill is the pinned acceptance criterion ---
+
+
+def test_fleet_failover_drill_solo_exact(
+    tmp_path, pool, solo_compaction
+):
+    """The ISSUE-16 acceptance drill, in-process: kill the owning
+    backend mid-job; the queued job is resubmitted by the dispatcher
+    through ``submit_id`` dedup, the running job is marked ``lost``,
+    and the widened resubmit warm-starts from the REPLICATED artifact
+    on the survivor — state-for-state solo-exact."""
+    chaos = _load_script("chaos")
+    out = chaos.run_fleet_chaos(
+        str(tmp_path / "drill"),
+        geom=GEOM,
+        solo=solo_compaction,
+        pool=pool,
+        log=lambda m: None,
+    )
+    assert out["resubmitted"] == 1
+    assert out["replicated_wire_bytes"] > 0
+    assert out["warm_mode"] in ("continue", "reseed")
+
+
+# ---- zero-compile warm submit THROUGH the dispatcher ----------------
+
+
+def test_fleet_warm_submit_pays_zero_jit_compiles(tmp_path):
+    """The resident-fleet payoff: after prewarm, a submit routed
+    through the dispatcher adds ZERO jitted programs — the same
+    ``set(ck._jits)`` harness as the service-layer proof, with the
+    routing hop in the loop."""
+    config = _config(
+        tmp_path / "b0",
+        visited_cap=1 << 8, frontier_cap=1 << 7, max_states=1 << 12,
+    )
+    own_pool = CheckerPool(config)
+    key, _compile_s = own_pool.warm("bookkeeper", BK_CFG)
+    ck = own_pool._checkers[key]
+    assert ck._jits  # genuinely warmed
+    keys_before = set(ck._jits)
+
+    daemon = ServiceDaemon(config, pool=own_pool)
+    daemon.start()
+    disp = FleetDispatcher(FleetConfig(
+        state_dir=str(tmp_path / "disp"),
+        backends=(config.socket_path,),
+        health_interval_s=0.2,
+    ))
+    disp.start()
+    try:
+        cl = ServiceClient(disp.config.socket_path, timeout=240.0)
+        r = cl.submit("bookkeeper", BK_CFG, full=True)
+        assert r["backend"] == config.socket_path
+        w = cl.wait(r["job_id"], timeout=600.0)
+        assert w["state"] == jobmod.DONE
+        assert w["result"]["status"] == "ok"
+        assert w["result"]["distinct_states"] == 297  # pinned oracle
+        assert set(ck._jits) == keys_before  # ZERO post-warm compiles
+    finally:
+        disp.shutdown()
+        daemon.shutdown()
+
+
+# ---- warm store: hammering concurrent writers (satellite 6) ---------
+
+
+def _mini_artifact(tmp_path, i):
+    """A tiny self-consistent (frame, manifest) pair for store ops."""
+    frame = str(tmp_path / f"frame{i}.npz")
+    with open(frame, "wb") as f:
+        f.write(os.urandom(256) + bytes([i % 256]) * 64)
+    manifest = {
+        "spec": "compaction",
+        "config_sig": f"sig-{i}",
+        "module_digest": "d" * 16,
+        "bindings": {},
+        "invariants": [],
+        "distinct_states": 10 + i,
+        "levels": 3,
+        "truncated": True,
+    }
+    return frame, manifest
+
+
+def test_warm_store_survives_hammering_writers(tmp_path):
+    """The fleet made the warm dir genuinely multi-writer: post-run
+    harvest saves, peer-push installs, and the LRU cap all run at
+    once.  N threads hammer saves + installs across overlapping sigs
+    under a tight byte cap; afterwards every surviving artifact must
+    verify digest-clean, no stage/tmp litter may remain, and the cap
+    must hold — a torn survivor here is the bug the ``_locked()``
+    store mutex exists to prevent."""
+    store = warmstore.WarmStore(
+        str(tmp_path / "warm"), max_bytes=2048
+    )
+    n_threads, n_iters, n_sigs = 6, 8, 4
+    frames = [_mini_artifact(tmp_path, i) for i in range(n_sigs)]
+    # a donor store provides published manifests for the install path
+    donor = warmstore.WarmStore(str(tmp_path / "donor"))
+    pushes = []
+    for frame, man in frames:
+        adir = donor.save(frame, dict(man))
+        assert adir is not None
+        full_man = donor.load_manifest(adir)
+        blobs = {
+            rel: open(os.path.join(adir, rel), "rb").read()
+            for rel in full_man["files"]
+        }
+        pushes.append((full_man, blobs))
+    errors = []
+
+    def hammer(tid):
+        try:
+            for it in range(n_iters):
+                i = (tid + it) % n_sigs
+                if (tid + it) % 2:
+                    frame, man = frames[i]
+                    store.save(frame, dict(man))
+                else:
+                    full_man, blobs = pushes[i]
+                    adir, why = store.install(dict(full_man), blobs)
+                    assert adir is not None, why
+        except Exception as e:  # surfaced after join
+            errors.append((tid, repr(e)))
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,), daemon=True)
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+        assert not t.is_alive()
+    assert errors == []
+    # every survivor verifies byte-for-byte
+    survivors = store.manifests()
+    assert survivors  # the cap never empties the store entirely
+    for adir, _man in survivors:
+        ok, reason = store.verify(adir)
+        assert ok, reason
+    # no writer litter: stage dirs and tmp files are all cleaned up
+    litter = [
+        n for n in os.listdir(store.root)
+        if n.startswith(".stage.") or ".tmp." in n
+    ]
+    assert litter == []
+    # the byte cap held through the concurrent mix (actual on-disk
+    # bytes, the same accounting the evictor uses)
+    total = sum(store.entry_bytes(adir) for adir, _man in survivors)
+    assert total <= store.max_bytes
+    # and a sweep finds nothing to quarantine
+    assert store.sweep() == []
+
+
+# ---- slow: 3-backend mixed-spec load test + bench artifact ----------
+
+
+@pytest.mark.slow
+def test_fleet_three_backend_load(
+    tmp_path, pool, cfg_dir, solo_compaction, solo_bk_crash2,
+    checker_mod,
+):
+    """Load shape: 3 backends, a mixed batch of compaction +
+    bookkeeper jobs through one dispatcher, every result solo-exact;
+    the measured queue throughput / route latency / replication bytes
+    are emitted as a bench_schema-10 artifact the validator accepts
+    and the ledger ingests."""
+    configs = [
+        _config(tmp_path / f"b{i}", slice_s=0.3) for i in range(3)
+    ]
+    daemons = [ServiceDaemon(configs[0], pool=pool)] + [
+        ServiceDaemon(c) for c in configs[1:]
+    ]
+    for d in daemons:
+        d.start()
+    fc = FleetConfig(
+        state_dir=str(tmp_path / "disp"),
+        backends=tuple(c.socket_path for c in configs),
+        health_interval_s=0.2,
+        fail_after=2,
+        backend_timeout_s=5.0,
+        sticky_s=0.0,  # load shape: spread by signal, no stickiness
+    )
+    disp = FleetDispatcher(fc)
+    disp.start()
+    t0 = time.monotonic()
+    try:
+        cl = ServiceClient(fc.socket_path, timeout=240.0)
+        subs = []
+        for i in range(3):
+            subs.append(("compaction", cl.submit(
+                "compaction", str(cfg_dir / "small_compaction.cfg"),
+                invariants=[], full=True,
+            )))
+            subs.append(("bookkeeper", cl.submit(
+                "bookkeeper", str(cfg_dir / "bk_crash2.cfg"),
+                full=True,
+            )))
+        used = set()
+        for spec, r in subs:
+            used.add(r["backend"])
+            w = cl.wait(r["job_id"], timeout=600.0)
+            assert w["state"] == jobmod.DONE
+            assert_result_matches_solo(
+                _Result(w),
+                solo_compaction if spec == "compaction"
+                else solo_bk_crash2,
+            )
+        assert len(used) >= 2  # the load genuinely spread
+        elapsed = time.monotonic() - t0
+        snap = disp.metrics_snapshot()
+    finally:
+        disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+
+    # BENCH-shaped artifact at the fleet rev (bench_schema 10)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__
+            ))), "bench.py",
+        )
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    routes = sum(snap["routes"].values())
+    d = bench.artifact_skeleton()
+    d.update(
+        metric="fleet_jobs_per_sec",
+        value=len(subs) / max(elapsed, 1e-9),
+        unit="jobs/s",
+        mode="fleet",
+        fleet_backends=len(configs),
+        fleet_jobs_per_sec=len(subs) / max(elapsed, 1e-9),
+        fleet_route_ms=(
+            1e3 * float(snap["route_s"]) / max(routes, 1)
+        ),
+        fleet_replicated_wire_bytes=sum(
+            snap["repl_bytes"].values()
+        ),
+    )
+    assert d["bench_schema"] == 10
+    errs = checker_mod.validate_bench_artifact(d, "fleet")
+    assert errs == []
+
+    # and the ledger ingests it at the new rev
+    from pulsar_tlaplus_tpu.obs import ledger as ledgermod
+
+    path = str(tmp_path / "ledger.jsonl")
+    art = str(tmp_path / "fleet_bench.json")
+    with open(art, "w") as f:
+        f.write(json.dumps(d))
+    rec = ledgermod.record_from_file(art)
+    assert rec["bench_schema"] == 10
+    assert ledgermod.append(path, [rec]) == 1
+    assert ledgermod.validate_ledger(path) == []

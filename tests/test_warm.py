@@ -186,7 +186,8 @@ def test_truncated_resubmit_continues_clean_shape(
     assert j1.result["distinct_states"] == 600
     # the truncation frame became a digest-verified warm artifact
     entries = [
-        d for d in os.listdir(config.warm_dir) if d != "quarantine"
+        d for d in os.listdir(config.warm_dir)
+        if d != "quarantine" and not d.startswith(".")
     ]
     assert len(entries) == 1
     ok, why = sched.warm_store.verify(
@@ -642,13 +643,15 @@ print("UNREACHED")  # the kill fires inside the harvest
     # before publish): a fresh scheduler quarantines it at startup
     config = ServiceConfig(state_dir=str(state), **GEOM)
     leftovers = [
-        d for d in os.listdir(config.warm_dir) if d != "quarantine"
+        d for d in os.listdir(config.warm_dir)
+        if d != "quarantine" and not d.startswith(".")
     ]
     assert leftovers  # the torn dir is there...
     sched = Scheduler(config)
     sched.recover()
     assert [
-        d for d in os.listdir(config.warm_dir) if d != "quarantine"
+        d for d in os.listdir(config.warm_dir)
+        if d != "quarantine" and not d.startswith(".")
     ] == []  # ...and swept into quarantine
     assert os.listdir(sched.warm_store.quarantine_dir)
     j = sched.submit(
@@ -669,7 +672,8 @@ def test_no_warm_opt_out(tmp_path, pool, cfg_dir):
     )
     sched.run_until_idle()
     assert [
-        d for d in os.listdir(config.warm_dir) if d != "quarantine"
+        d for d in os.listdir(config.warm_dir)
+        if d != "quarantine" and not d.startswith(".")
     ] == []  # no artifact harvested
     j2 = sched.submit("compaction", cfg, warm=False)
     assert j2.warm_reason == warm_plan.REASON_OPT_OUT
