@@ -270,10 +270,10 @@ def load_metrics_records(metrics_path):
 
 
 def artifact_skeleton() -> dict:
-    """Every bench_schema-10 required key, None-filled — the
+    """Every bench_schema-11 required key, None-filled — the
     simulate, matrix, and fleet paths fill what applies and stay
     validator-clean (scripts/check_telemetry_schema.py
-    BENCH_KEYS_V10: keys are REQUIRED, values may be null where the
+    BENCH_KEYS_V11: keys are REQUIRED, values may be null where the
     mode has no measurement)."""
     keys = (
         "metric", "value", "unit", "vs_baseline",
@@ -291,9 +291,12 @@ def artifact_skeleton() -> dict:
         # fleet keys (r20, bench_schema 10): null on non-fleet runs
         "fleet_backends", "fleet_jobs_per_sec", "fleet_route_ms",
         "fleet_replicated_wire_bytes",
+        # fleet survivability latencies (r21, bench_schema 11): null
+        # on non-fleet runs and on drills that saw no drain/rejoin
+        "fleet_failover_ms", "fleet_reconcile_ms",
     )
     d = {k: None for k in keys}
-    d["bench_schema"] = 10
+    d["bench_schema"] = 11
     return d
 
 
@@ -565,7 +568,7 @@ def run_matrix(args) -> None:
             f"{args.matrix_ledger}",
             file=sys.stderr,
         )
-    print(json.dumps({"matrix": results, "bench_schema": 10}))
+    print(json.dumps({"matrix": results, "bench_schema": 11}))
 
 
 # -------------------------------------------------------------- fleet
@@ -602,10 +605,12 @@ def run_fleet_bench(args) -> None:
     """``--fleet N``: spin N local ``serve`` backends plus one
     dispatcher in-process (unix sockets under a scratch dir), push a
     replication probe and a mixed batch through the single endpoint,
-    and emit ONE bench_schema-10 JSON line with the fleet keys —
+    and emit ONE bench_schema-11 JSON line with the fleet keys —
     queue throughput (fleet_jobs_per_sec), mean route latency
-    (fleet_route_ms), and sieve replication economy
-    (fleet_replicated_wire_bytes) — ingestible by ``cli.py ledger
+    (fleet_route_ms), sieve replication economy
+    (fleet_replicated_wire_bytes), and the r21 survivability
+    latencies (fleet_failover_ms / fleet_reconcile_ms, null when the
+    run saw no drain or rejoin) — ingestible by ``cli.py ledger
     add`` and gateable by ``ledger gate`` (docs/fleet.md)."""
     import shutil
     import tempfile
@@ -741,6 +746,14 @@ def run_fleet_bench(args) -> None:
         fleet_jobs_per_sec=round(jobs_per_sec, 3),
         fleet_route_ms=round(route_ms, 3),
         fleet_replicated_wire_bytes=repl_bytes,
+        fleet_failover_ms=(
+            round(1e3 * float(snap["failover_s"]) / snap["failover_n"], 3)
+            if snap.get("failover_n") else None
+        ),
+        fleet_reconcile_ms=(
+            round(1e3 * float(snap["reconcile_s"]) / snap["reconcile_n"], 3)
+            if snap.get("reconcile_n") else None
+        ),
     )
     print(json.dumps(d))
 
@@ -782,7 +795,7 @@ def parse_args(argv=None):
         help="fleet bench: spin N local serve backends + one "
         "dispatcher in-process and measure queue throughput / route "
         "latency / replication wire bytes through the single "
-        "endpoint (bench_schema-10 fleet_* keys; docs/fleet.md)",
+        "endpoint (bench_schema-11 fleet_* keys; docs/fleet.md)",
     )
     ap.add_argument(
         "--matrix", action="store_true",
@@ -1207,8 +1220,12 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # steps_per_state — null on check-mode runs);
                 # schema 10 (r20) adds the fleet-dispatcher keys
                 # (fleet_backends, fleet_jobs_per_sec, fleet_route_ms,
-                # fleet_replicated_wire_bytes — null on solo runs)
-                "bench_schema": 10,
+                # fleet_replicated_wire_bytes — null on solo runs);
+                # schema 11 (r21) adds the fleet survivability
+                # latencies (fleet_failover_ms, fleet_reconcile_ms —
+                # null on solo runs and on drills without a
+                # drain/rejoin)
+                "bench_schema": 11,
                 "mode": "check",
                 "walks_per_sec": None,
                 "steps_per_state": None,
@@ -1216,6 +1233,8 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 "fleet_jobs_per_sec": None,
                 "fleet_route_ms": None,
                 "fleet_replicated_wire_bytes": None,
+                "fleet_failover_ms": None,
+                "fleet_reconcile_ms": None,
                 "vs_baseline_definition": "native_8w_extrapolated",
                 "vs_baseline": round(
                     r.states_per_sec / max(nat8_extrap, 1e-9), 2

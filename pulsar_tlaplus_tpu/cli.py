@@ -861,6 +861,10 @@ def _cmd_dispatch(args) -> int:
         fail_after=args.fail_after,
         backend_timeout_s=args.backend_timeout,
         replicate=not args.no_replicate,
+        recover=args.recover,
+        readmit_after=args.readmit_after,
+        hold_max=args.hold_max,
+        hold_s=args.hold_s,
     )
     try:
         disp = FleetDispatcher(config, log=log)
@@ -1678,6 +1682,31 @@ def main(argv=None):
         help="disable warm-artifact replication between backends "
         "(jobs still route and fail over; resubmits only warm-start "
         "on their original backend)",
+    )
+    pd.add_argument(
+        "--recover", action="store_true",
+        help="rebuild the routing table from fleet_jobs.json + a "
+        "re-poll of every backend before accepting work (after a "
+        "crash or kill -9): acked jobs resolve exactly-once, "
+        "unconfirmed jobs on reachable backends are typed 'lost' "
+        "(docs/fleet.md, Survivability)",
+    )
+    pd.add_argument(
+        "--readmit-after", type=int, default=2, metavar="N",
+        help="consecutive clean polls before a drained backend "
+        "rejoins routing (default 2 — hysteresis so a flapping "
+        "backend cannot thrash failover)",
+    )
+    pd.add_argument(
+        "--hold-max", type=int, default=16, metavar="N",
+        help="submits held waiting for a backend while the whole "
+        "fleet is down (overflow sheds with a typed 'capacity' "
+        "rejection; default 16)",
+    )
+    pd.add_argument(
+        "--hold-s", type=float, default=10.0, metavar="SEC",
+        help="how long a held submit waits for a backend to rejoin "
+        "before the typed backend_unavailable rejection (default 10s)",
     )
 
     pj = sub.add_parser(

@@ -34,6 +34,28 @@ The dispatcher forwards the client's own token to TCP backends —
 per-tenant quotas and telemetry attribution hold end-to-end — and
 authenticates AS ``auth.FLEET_TENANT`` for its own polling and
 replication traffic.
+
+Survivability (r21, docs/fleet.md "Failure modes"):
+
+- **Crash-safe**: every routing decision, stickiness entry, and
+  failover transition is persisted through the atomic tmp+replace
+  discipline BEFORE the client is acked; a persist failure retries
+  once (the r17 scheduler's ENOSPC semantics) and is counted in
+  ``persist_failures`` instead of silently running memory-only.
+  ``dispatch --recover`` quarantines a torn ``fleet_jobs.json`` and
+  rebuilds the job table by re-polling every backend's authoritative
+  job table — an acked submit resolves exactly-once after a kill -9.
+- **Partition-tolerant**: the registry drains on timeouts as fast as
+  on refused connects, readmits only after ``readmit_after``
+  consecutive clean polls (flap hysteresis), and an all-backends-down
+  window degrades to a bounded queue-and-hold (``hold_max`` held
+  submits for up to ``hold_s`` each; past the buffer, a typed
+  ``capacity`` shed) — never a crash, never a hang.
+- **Lost-job reconciliation**: a drained backend that rejoins is
+  re-polled for the jobs the dispatcher typed ``lost`` — finished
+  ones deliver their real result (``lost`` -> terminal with a
+  ``reconciled`` marker), still-running ones resume watch relay;
+  exactly-once is the existing ``submit_id`` dedup.
 """
 
 from __future__ import annotations
@@ -55,6 +77,7 @@ from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.service import auth as authmod
 from pulsar_tlaplus_tpu.service import jobs as jobmod
 from pulsar_tlaplus_tpu.service import protocol
+from pulsar_tlaplus_tpu.utils import faults
 
 # job-table states the dispatcher itself assigns (beyond jobs.STATES):
 # a job that was RUNNING on a backend that died is not silently
@@ -63,11 +86,41 @@ from pulsar_tlaplus_tpu.service import protocol
 # warm wherever replication reached)
 LOST = "lost"
 
+# watch relays run in legs of this many seconds (r21): the owner is
+# re-resolved between legs so a failover reroutes the relay even when
+# the old backend keeps its established stream open (a gracefully
+# draining daemon never severs connections — only the leg boundary
+# lets the relay notice the job will never run there again)
+_WATCH_RELAY_LEG_S = 2.0
+
 # submit fields forwarded verbatim to the chosen backend
 _SUBMIT_FIELDS = (
     "spec", "cfg", "invariants", "max_states", "time_budget_s",
     "priority", "deadline_s", "mode", "sim", "warm",
 )
+
+
+def _write_json_atomic(path: str, obj, _inject=None):
+    """Write ``obj`` as JSON through a per-process tmp +
+    ``os.replace``, removing the half-written tmp on failure.
+    Returns None on success, the ``OSError`` on failure — the same
+    contract as the scheduler's helper, so the dispatcher's persist
+    path gets the same retry-or-log discipline (``_inject`` is the
+    PTT_FAULT hook)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            if _inject is not None:
+                raise _inject
+            json.dump(obj, f)
+        os.replace(tmp, path)
+        return None
+    except OSError as e:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return e
 
 
 @dataclass
@@ -83,6 +136,11 @@ class FleetConfig:
     sticky_s: float = 300.0
     replicate: bool = True
     telemetry_path: str = ""  # default <state_dir>/dispatch.jsonl
+    # r21 survivability knobs
+    readmit_after: int = 2  # consecutive clean polls to rejoin
+    recover: bool = False  # rebuild the job table from backends
+    hold_max: int = 16  # all-backends-down: held submits before shed
+    hold_s: float = 10.0  # ... and how long each waits for a backend
 
     def __post_init__(self):
         if not self.socket_path:
@@ -143,6 +201,7 @@ class FleetDispatcher:
             fail_after=config.fail_after,
             timeout=config.backend_timeout_s,
             sticky_s=config.sticky_s,
+            readmit_after=config.readmit_after,
             log=self._log,
         )
         self._tcp_addr = None
@@ -154,7 +213,17 @@ class FleetDispatcher:
         #            done_handled}
         self._jobs: Dict[str, dict] = {}
         self._jobs_lock = threading.Lock()
+        # persist bookkeeping (r21): sequence counter for the
+        # PTT_FAULT "persist" site + the public failure counter
+        self._persist_n = 0
+        self.persist_failures = 0
+        self._quarantined_path: Optional[str] = None
         self._load_jobs()
+        # all-backends-down queue-and-hold (r21): submits held while
+        # the fleet recovers, bounded so the buffer can't grow
+        # without limit — past it, a typed `capacity` shed
+        self._held = 0
+        self._held_lock = threading.Lock()
         # host-side counters behind metrics_snapshot()
         self._ctr_lock = threading.Lock()
         self._routes: Dict[Tuple[str, str], float] = {}
@@ -163,6 +232,15 @@ class FleetDispatcher:
         self._repl_bytes: Dict[str, float] = {}
         self._failovers: Dict[str, float] = {}
         self._resub: Dict[str, float] = {}
+        self._reconciled: Dict[str, float] = {}
+        self._partitions: Dict[str, float] = {}
+        self._recoveries = 0.0
+        self._held_sheds = 0.0
+        # failover/reconcile latency accumulators (bench_schema 11)
+        self._failover_s = 0.0
+        self._failover_n = 0
+        self._reconcile_s = 0.0
+        self._reconcile_n = 0
         self._sock: Optional[socket.socket] = None
         self._tcp_sock: Optional[socket.socket] = None
         self.tcp_port: Optional[int] = None
@@ -199,10 +277,18 @@ class FleetDispatcher:
     # --------------------------------------------------- job table
 
     def _load_jobs(self) -> None:
+        """Load ``fleet_jobs.json``; a torn or corrupt file is
+        QUARANTINED (renamed aside, like the scheduler's torn-queue
+        recovery) instead of silently ignored — ``--recover`` then
+        rebuilds the table from the backends' authoritative job
+        tables, so quarantine never strands an acked job."""
         try:
             with open(self.config.jobs_path) as f:
                 snap = json.load(f)
-        except (OSError, json.JSONDecodeError):
+        except FileNotFoundError:
+            return
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            self._quarantine_jobs_file(e)
             return
         if isinstance(snap, dict) and isinstance(
             snap.get("jobs"), dict
@@ -212,15 +298,57 @@ class FleetDispatcher:
                 for k, v in snap["jobs"].items()
                 if isinstance(v, dict)
             }
+            self.registry.restore_sticky(snap.get("sticky"))
+        else:
+            self._quarantine_jobs_file(
+                ValueError("unrecognized fleet_jobs.json shape")
+            )
+
+    def _quarantine_jobs_file(self, err: BaseException) -> None:
+        dst = f"{self.config.jobs_path}.corrupt.{int(time.time())}"
+        try:
+            os.replace(self.config.jobs_path, dst)
+        except OSError:
+            return
+        self._quarantined_path = dst
+        self._log(
+            f"fleet: fleet_jobs.json unreadable ({err!r:.120}); "
+            f"quarantined to {dst} — run dispatch --recover to "
+            "rebuild from the backends"
+        )
 
     def _save_jobs_locked(self) -> None:
-        tmp = self.config.jobs_path + ".tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump({"fleet_jobs_v": 1, "jobs": self._jobs}, f)
-            os.replace(tmp, self.config.jobs_path)
-        except OSError as e:
-            self._log(f"fleet: jobs persist failed ({e!r:.120})")
+        """Atomic tmp+replace persist with the r17 scheduler's
+        retry-once semantics: the first failure frees the tmp and
+        retries immediately (a transient ENOSPC often clears);
+        the second is counted in ``persist_failures`` and surfaced
+        in ``ptt_fleet_*`` + the status listing — the dispatcher
+        keeps serving, the NEXT transition retries."""
+        snap = {
+            "fleet_jobs_v": 2,
+            "jobs": self._jobs,
+            "sticky": self.registry.sticky_snapshot(),
+        }
+        self._persist_n += 1
+        inject = "enospc" in faults.poll("persist", self._persist_n)
+        for attempt in (0, 1):
+            err = _write_json_atomic(
+                self.config.jobs_path, snap,
+                _inject=(
+                    faults.enospc_error("persist", self._persist_n)
+                    if inject and attempt == 0
+                    else None
+                ),
+            )
+            if err is None:
+                return
+            if attempt == 1:
+                self.persist_failures += 1
+                self._log(
+                    f"fleet: fleet_jobs.json persist FAILED "
+                    f"({err!r:.120}); continuing — next transition "
+                    "retries"
+                )
 
     def _record_job(self, job_id: str, rec: dict) -> None:
         with self._jobs_lock:
@@ -249,11 +377,146 @@ class FleetDispatcher:
                 "repl_bytes": dict(self._repl_bytes),
                 "failovers": dict(self._failovers),
                 "resubmitted": dict(self._resub),
+                "reconciled": dict(self._reconciled),
+                "partitions": dict(self._partitions),
+                "recoveries": self._recoveries,
+                "persist_failures": float(self.persist_failures),
+                "held_sheds": self._held_sheds,
+                "failover_s": self._failover_s,
+                "failover_n": self._failover_n,
+                "reconcile_s": self._reconcile_s,
+                "reconcile_n": self._reconcile_n,
             }
+
+    # ---------------------------------------------------- recovery
+
+    def recover(self) -> None:
+        """Rebuild the routing table and in-flight map after a crash
+        (``dispatch --recover``).  ``fleet_jobs.json`` is the acked
+        intent; each backend's own job table is the authority on what
+        actually landed.  Re-polling every backend reconciles the
+        two: tracked jobs take the backend's current state, jobs the
+        dispatcher routed but cannot find anywhere are typed
+        ``lost`` (their backend is down or forgot them), and jobs a
+        backend holds under a known ``submit_id`` that the (possibly
+        quarantined) table lost are re-adopted — an acked submit
+        resolves exactly-once either way."""
+        t0 = time.monotonic()
+        with self._jobs_lock:
+            known = {jid: dict(rec) for jid, rec in self._jobs.items()}
+        by_submit_id = {
+            rec.get("submit_id"): jid
+            for jid, rec in known.items()
+            if rec.get("submit_id") and not rec.get("alias_of")
+        }
+        confirmed: set = set()
+        adopted = 0
+        unreachable: List[str] = []
+        for addr in self.config.backends:
+            auth = self.fleet_token if protocol.is_tcp(addr) else None
+            try:
+                resp = protocol.request(
+                    addr, "status",
+                    timeout=self.config.backend_timeout_s,
+                    **({"auth": auth} if auth else {}),
+                )
+            except (OSError, protocol.ProtocolError) as e:
+                unreachable.append(addr)
+                self._log(
+                    f"fleet: recover could not reach {addr} "
+                    f"({e!r:.120}) — its jobs stay as persisted"
+                )
+                continue
+            if not resp.get("ok"):
+                unreachable.append(addr)
+                continue
+            for summ in resp.get("jobs") or []:
+                bjid = summ.get("job_id")
+                state = summ.get("state")
+                if not bjid or not state:
+                    continue
+                jid = None
+                if bjid in known:
+                    jid = bjid
+                elif summ.get("submit_id") in by_submit_id:
+                    # the backend knows this submit under a fresh id
+                    # (a failover resubmit the old dispatcher never
+                    # recorded): re-alias instead of re-adopting
+                    jid = by_submit_id[summ.get("submit_id")]
+                    self._update_job(jid, backend_job_id=bjid)
+                if jid is not None:
+                    confirmed.add(jid)
+                    rec = known.get(jid) or {}
+                    if rec.get("alias_of"):
+                        continue
+                    terminal = state in (
+                        jobmod.DONE, jobmod.FAILED, jobmod.CANCELLED,
+                    )
+                    self._update_job(
+                        jid, backend=addr, state=state,
+                        **(
+                            {"done_handled": True} if terminal else {}
+                        ),
+                    )
+                    continue
+                if summ.get("submit_id"):
+                    # routed by a previous life of this dispatcher
+                    # (or quarantined out of the table): adopt it so
+                    # status/result/watch resolve again
+                    adopted += 1
+                    self._record_job(
+                        bjid,
+                        {
+                            "backend": addr,
+                            "tenant": summ.get(
+                                "tenant", authmod.LOCAL_TENANT
+                            ),
+                            "state": state,
+                            "submit_id": summ.get("submit_id"),
+                            "submit": {},
+                            "done_handled": False,
+                            "recovered": True,
+                        },
+                    )
+        lost = 0
+        unreachable_set = set(unreachable)
+        for jid, rec in known.items():
+            if jid in confirmed or rec.get("alias_of"):
+                continue
+            if rec.get("state") in (
+                jobmod.DONE, jobmod.FAILED, jobmod.CANCELLED, LOST,
+            ):
+                continue
+            if rec.get("backend") in unreachable_set:
+                continue  # the health loop will drain + fail it over
+            # the backend answered and does not know the job: the
+            # acked record is the only trace left — type it lost so
+            # the client gets the truth, never a silent drop
+            lost += 1
+            self._update_job(jid, state=LOST)
+        with self._ctr_lock:
+            self._recoveries += 1
+        self.tel.emit(
+            "recover",
+            jobs=len(known),
+            confirmed=len(confirmed),
+            adopted=adopted,
+            lost=lost,
+            quarantined=bool(self._quarantined_path),
+            wall_ms=round((time.monotonic() - t0) * 1000.0, 3),
+        )
+        self._log(
+            f"fleet: recover reconciled {len(known)} persisted "
+            f"job(s) against {len(self.config.backends)} backend(s): "
+            f"{len(confirmed)} confirmed, {adopted} adopted, "
+            f"{lost} lost, {len(unreachable)} backend(s) unreachable"
+        )
 
     # --------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        if self.config.recover:
+            self.recover()
         try:
             os.remove(self.config.socket_path)
         except OSError:
@@ -368,8 +631,19 @@ class FleetDispatcher:
     def _health_loop(self) -> None:
         while not self._shutdown_evt.is_set():
             try:
-                for b in self.registry.poll_once():
+                newly_down, newly_up = self.registry.poll_once()
+                for b in newly_down:
+                    t0 = time.monotonic()
                     self._failover(b)
+                    with self._ctr_lock:
+                        self._failover_s += time.monotonic() - t0
+                        self._failover_n += 1
+                for b in newly_up:
+                    t0 = time.monotonic()
+                    self._reconcile(b)
+                    with self._ctr_lock:
+                        self._reconcile_s += time.monotonic() - t0
+                        self._reconcile_n += 1
                 self._sweep_jobs()
             except Exception as e:  # noqa: BLE001 — the health loop
                 #                      must survive any single pass
@@ -443,6 +717,11 @@ class FleetDispatcher:
                 backend=target.addr,
                 state=resp.get("state", jobmod.QUEUED),
                 backend_job_id=new_id,
+                # a watch reconnect's byte offset was minted against
+                # the OLD backend's event log: _op_watch restarts a
+                # failed-over stream from 0 and lets the client's
+                # (run_id, seq) dedup drop the replay
+                failed_over=True,
             )
             if new_id and new_id != jid:
                 # the new backend minted a fresh id: alias it so
@@ -454,6 +733,7 @@ class FleetDispatcher:
                         "backend": target.addr,
                         "state": resp.get("state", jobmod.QUEUED),
                         "alias_of": jid,
+                        "failed_over": True,
                     },
                 )
             resubmitted += 1
@@ -471,6 +751,83 @@ class FleetDispatcher:
             f"fleet: failover from {backend.addr} "
             f"({resubmitted} queued job(s) resubmitted)"
         )
+
+    def _reconcile(self, backend) -> None:
+        """A drained backend survived readmission hysteresis and
+        rejoined: re-poll it for the jobs the dispatcher typed
+        ``lost`` when it went dark.  A backend that still holds its
+        jobs was PARTITIONED, not dead — finished jobs deliver their
+        real result (``lost`` -> terminal with a ``reconciled``
+        marker), still-running ones resume status/result/watch relay.
+        Exactly-once is the existing ``submit_id`` dedup: the job
+        only ever ran on this backend."""
+        with self._jobs_lock:
+            lost_jobs = [
+                (jid, dict(rec))
+                for jid, rec in self._jobs.items()
+                if rec.get("state") == LOST
+                and rec.get("backend") == backend.addr
+                and not rec.get("alias_of")
+            ]
+        auth = (
+            self.fleet_token
+            if protocol.is_tcp(backend.addr)
+            else None
+        )
+        reconciled = 0
+        for jid, rec in lost_jobs:
+            try:
+                resp = protocol.request(
+                    backend.addr, "status",
+                    timeout=self.config.backend_timeout_s,
+                    job_id=rec.get("backend_job_id") or jid,
+                    **({"auth": auth} if auth else {}),
+                )
+            except (OSError, protocol.ProtocolError):
+                return  # went dark again; the next rejoin retries
+            if not resp.get("ok"):
+                continue  # the backend forgot it: stays lost
+            state = (resp.get("job") or {}).get("state")
+            if state is None or state == LOST:
+                continue
+            terminal = state in (
+                jobmod.DONE, jobmod.FAILED, jobmod.CANCELLED,
+            )
+            self._update_job(
+                jid, state=state, reconciled=True,
+                **({"done_handled": True} if terminal else {}),
+            )
+            reconciled += 1
+            with self._ctr_lock:
+                self._reconciled[backend.addr] = (
+                    self._reconciled.get(backend.addr, 0) + 1
+                )
+            self.tel.emit(
+                "reconcile",
+                backend=backend.addr,
+                job_id=jid,
+                state=state,
+            )
+            if terminal and self.config.replicate:
+                self._replicate_from(backend.addr)
+        if lost_jobs:
+            # it held jobs through the outage: that was a partition
+            # window closing, not a restart
+            with self._ctr_lock:
+                self._partitions[backend.addr] = (
+                    self._partitions.get(backend.addr, 0) + 1
+                )
+            self.tel.emit(
+                "partition",
+                backend=backend.addr,
+                lost_jobs=len(lost_jobs),
+                reconciled=reconciled,
+            )
+            self._log(
+                f"fleet: backend {backend.addr} rejoined holding "
+                f"{reconciled}/{len(lost_jobs)} lost job(s) — "
+                "reconciled"
+            )
 
     def _sweep_jobs(self) -> None:
         """Track every routed job to its terminal state; a terminal
@@ -681,6 +1038,7 @@ class FleetDispatcher:
                 "fleet": True,
                 "backends": self.registry.snapshot(),
                 "jobs": counts,
+                "persist_failures": self.persist_failures,
                 "warmed": [],
             },
         )
@@ -704,29 +1062,51 @@ class FleetDispatcher:
         fwd["submit_id"] = submit_id
         tried: set = set()
         last_err = "no healthy backend"
-        healthy = sorted(
-            self.registry.healthy(), key=lambda b: b.score()
-        )
-        candidates: List = []
-        if sticky_owner is not None:
-            # a dedup-keyed retry must land on the SAME backend to
-            # get the same job back
+
+        def _candidates() -> List:
+            healthy = sorted(
+                self.registry.healthy(), key=lambda b: b.score()
+            )
+            out: List = []
+            if sticky_owner is not None:
+                # a dedup-keyed retry must land on the SAME backend
+                # to get the same job back
+                for b in healthy:
+                    if b.addr == sticky_owner:
+                        out.append((b, "sticky"))
+                        break
+            if healthy and not out:
+                chosen, why = self.registry.choose(tenant)
+                if chosen is not None:
+                    out.append((chosen, why))
+            # every other healthy backend is a fallback: a connect
+            # failure on the first pick must not bounce the submit
+            # while the fleet still has capacity
+            placed = {c.addr for c, _ in out}
             for b in healthy:
-                if b.addr == sticky_owner:
-                    candidates.append((b, "sticky"))
-                    break
-        if healthy and not candidates:
-            chosen, why = self.registry.choose(tenant)
-            if chosen is not None:
-                candidates.append((chosen, why))
-        # every other healthy backend is a fallback: a connect
-        # failure on the first pick must not bounce the submit while
-        # the fleet still has capacity
-        placed = {c.addr for c, _ in candidates}
-        for b in healthy:
-            if b.addr not in placed:
-                candidates.append((b, "least_loaded"))
-                placed.add(b.addr)
+                if b.addr not in placed:
+                    out.append((b, "least_loaded"))
+                    placed.add(b.addr)
+            return out
+
+        candidates = _candidates()
+        if not candidates:
+            # all-backends-down window (r21): degrade to a bounded
+            # queue-and-hold instead of bouncing instantly — a fleet
+            # mid-failover usually recovers within one health
+            # interval, and the hold absorbs it invisibly
+            candidates = self._hold_for_fleet(_candidates)
+            if candidates is None:
+                protocol.send_json(
+                    w,
+                    protocol.error_response(
+                        f"fleet hold buffer full "
+                        f"({self.config.hold_max} submit(s) already "
+                        "waiting for a backend); retry later",
+                        code="capacity",
+                    ),
+                )
+                return
         if not candidates:
             protocol.send_json(
                 w,
@@ -799,6 +1179,38 @@ class FleetDispatcher:
             ),
         )
 
+    def _hold_for_fleet(self, rebuild) -> Optional[List]:
+        """Bounded queue-and-hold for an all-backends-down window:
+        the submit waits up to ``hold_s`` for any backend to come
+        back, with at most ``hold_max`` submits held at once.
+        Returns the fresh candidate list when a backend appears, an
+        empty list when the hold expired (caller answers the typed
+        ``backend_unavailable``), or None when the buffer was full
+        (caller answers the typed ``capacity`` shed — never a crash,
+        never an unbounded pile-up)."""
+        with self._held_lock:
+            if self._held >= self.config.hold_max:
+                with self._ctr_lock:
+                    self._held_sheds += 1
+                return None
+            self._held += 1
+        try:
+            deadline = time.monotonic() + self.config.hold_s
+            while (
+                time.monotonic() < deadline
+                and not self._shutdown_evt.is_set()
+            ):
+                self._shutdown_evt.wait(
+                    min(0.1, self.config.health_interval_s)
+                )
+                out = rebuild()
+                if out:
+                    return out
+            return []
+        finally:
+            with self._held_lock:
+                self._held -= 1
+
     def _owner_of(self, req) -> Tuple[str, str, Optional[str]]:
         """(backend addr, backend-side job id, forward token) for the
         request's ``job_id``; raises ValueError when untracked."""
@@ -860,6 +1272,11 @@ class FleetDispatcher:
                     "state": rec.get("state"),
                     "tenant": rec.get("tenant"),
                     "backend": rec.get("backend"),
+                    **(
+                        {"reconciled": True}
+                        if rec.get("reconciled")
+                        else {}
+                    ),
                 }
                 for jid, rec in sorted(self._jobs.items())
                 if not rec.get("alias_of")
@@ -868,7 +1285,16 @@ class FleetDispatcher:
                     or rec.get("tenant") == tenant
                 )
             ]
-        protocol.send_json(w, {"ok": True, "jobs": jobs})
+        protocol.send_json(
+            w,
+            {
+                "ok": True,
+                "jobs": jobs,
+                # surfaced so a memory-only dispatcher is visible in
+                # `ptt status`, not just in metrics (r21)
+                "persist_failures": self.persist_failures,
+            },
+        )
 
     def _op_result(self, req, w) -> None:
         self._proxy(req, w, "result")
@@ -879,37 +1305,122 @@ class FleetDispatcher:
     def _op_watch(self, req, w) -> None:
         """Relay the owning backend's watch stream line-for-line;
         the client's (run_id, seq) dedup and ``pos`` resume work
-        unchanged because the dispatcher forwards both verbatim."""
-        addr, backend_jid, auth = self._owner_of(req)
+        unchanged because the dispatcher forwards both verbatim —
+        EXCEPT across a failover (r21): a reconnect offset was
+        minted against the dead backend's event log, so a
+        failed-over job restarts its relay from 0 and the client's
+        (run_id, seq) join drops the replayed prefix (duplicates are
+        survivable, silently skipped bytes are not).
+
+        The relay runs in short LEGS (the backend is asked to watch
+        for ``_WATCH_RELAY_LEG_S`` at a time, resuming by ``pos``):
+        the owner is re-resolved between legs, so a failover is
+        picked up even when the old connection never breaks — a
+        gracefully-draining backend keeps its established streams
+        open and would otherwise hold the relay on a job table that
+        will never run the job again.  A mid-leg transport failure
+        after the ack rides through the same loop (the record flips
+        ``failed_over`` within one health interval and the next leg
+        attaches to the new owner from 0)."""
         timeout_s = float(req.get("timeout_s", 3600.0))
-        # raw relay (not protocol.stream, which EATS the ack): the
-        # backend's acknowledgment, every event, and the done summary
-        # all pass through byte-equivalent, so the client's dedup and
-        # pos-resume machinery cannot tell a dispatcher from a daemon
-        with protocol.connect(addr, timeout_s + 30.0) as s:
-            br = s.makefile("r", encoding="utf-8")
-            bw = s.makefile("w", encoding="utf-8")
-            protocol.send_json(
-                bw,
-                {
-                    "op": "watch",
-                    "job_id": backend_jid,
-                    "timeout_s": timeout_s,
-                    "offset": max(0, int(req.get("offset") or 0)),
-                    **({"auth": auth} if auth else {}),
-                },
+        deadline = time.monotonic() + timeout_s
+        addr, _bjid, _auth = self._owner_of(req)
+        with self._jobs_lock:
+            rec = self._jobs.get(req["job_id"]) or {}
+            failed_over = bool(rec.get("failed_over"))
+        last_pos = (
+            0 if failed_over else max(0, int(req.get("offset") or 0))
+        )
+        cur_addr = addr
+        sent_ack = False
+        while True:
+            # re-resolve the owner EVERY leg: _owner_of raises the
+            # typed lost/unknown refusal if the job died with its
+            # backend, and a failed-over record points at the new
+            # owner whose event log starts over at offset 0
+            addr, backend_jid, auth = self._owner_of(req)
+            if addr != cur_addr:
+                cur_addr, last_pos = addr, 0
+            leg = min(
+                _WATCH_RELAY_LEG_S,
+                max(0.1, deadline - time.monotonic()),
             )
-            while True:
-                msg = protocol.recv_json(br)
-                if msg is None:
-                    raise protocol.ProtocolError(
-                        "backend closed the watch stream mid-relay"
+            try:
+                # raw relay (not protocol.stream, which EATS the
+                # ack): the backend's acknowledgment, every event,
+                # and the done summary pass through byte-equivalent,
+                # so the client's dedup and pos-resume machinery
+                # cannot tell a dispatcher from a daemon — the ack is
+                # forwarded exactly once across all legs
+                with protocol.connect(addr, leg + 30.0) as s:
+                    br = s.makefile("r", encoding="utf-8")
+                    bw = s.makefile("w", encoding="utf-8")
+                    protocol.send_json(
+                        bw,
+                        {
+                            "op": "watch",
+                            "job_id": backend_jid,
+                            "timeout_s": leg,
+                            "offset": last_pos,
+                            **({"auth": auth} if auth else {}),
+                        },
                     )
-                protocol.send_json(w, msg)
-                if "done" in msg or "error" in msg:
-                    return
-                if not msg.get("ok", True):
-                    return
+                    while True:
+                        msg = protocol.recv_json(br)
+                        if msg is None:
+                            raise protocol.ProtocolError(
+                                "backend closed the watch stream "
+                                "mid-relay"
+                            )
+                        if msg.get("streaming"):
+                            if not sent_ack:
+                                sent_ack = True
+                                protocol.send_json(w, msg)
+                            continue
+                        if (
+                            "error" in msg
+                            and str(msg.get("error", "")).startswith(
+                                "watch timed out"
+                            )
+                        ):
+                            # the LEG expired, not the client's
+                            # watch: reattach (re-resolving the
+                            # owner) unless the real deadline passed
+                            if time.monotonic() < deadline:
+                                break
+                            protocol.send_json(
+                                w,
+                                protocol.error_response(
+                                    f"watch timed out after "
+                                    f"{timeout_s}s (job "
+                                    f"{req['job_id']} still "
+                                    f"{rec.get('state', '?')})"
+                                ),
+                            )
+                            return
+                        if "event" in msg and isinstance(
+                            msg.get("pos"), int
+                        ):
+                            last_pos = msg["pos"]
+                        protocol.send_json(w, msg)
+                        if "done" in msg or "error" in msg:
+                            return
+                        if not msg.get("ok", True):
+                            return
+            except (OSError, protocol.ProtocolError):
+                if not sent_ack:
+                    # nothing forwarded yet: surface the refusal so
+                    # the client's own (transient) retry drives
+                    raise
+                if time.monotonic() >= deadline:
+                    raise
+                # mid-stream break: the owner died for real — wait
+                # out the failover and reattach on the next leg
+                time.sleep(
+                    min(0.3, self.config.health_interval_s)
+                )
+            with self._jobs_lock:
+                rec = self._jobs.get(req["job_id"]) or {}
 
     def _op_metrics(self, req, w) -> None:
         from pulsar_tlaplus_tpu.obs import metrics as metrics_mod

@@ -10,8 +10,14 @@ needs no fleet-specific instrumentation to join.
 Routing policy (docs/fleet.md, "Routing"):
 
 - only ``up`` backends are eligible; a backend is drained (``down``)
-  after ``fail_after`` consecutive poll failures and rejoins on its
-  first clean poll.
+  after ``fail_after`` consecutive poll failures and readmitted only
+  after ``readmit_after`` CONSECUTIVE clean polls (r21 hysteresis —
+  a flapping backend must not thrash failover: one lucky poll in the
+  middle of a die/return cycle is not health).
+- a failed or timed-out poll worsens the backend's routing score
+  IMMEDIATELY (r21): a hung backend must not coast on its last-known
+  -good signal for ``fail_after`` intervals while new work piles
+  onto it.
 - per-tenant stickiness ONLY while warm locality pays: a tenant's
   last backend is reused while its load is within ``sticky_slack`` of
   the best backend — a hot backend forfeits stickiness, because a
@@ -30,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 from pulsar_tlaplus_tpu.obs import metrics as obs_metrics
 from pulsar_tlaplus_tpu.service import protocol
+from pulsar_tlaplus_tpu.utils import faults
 
 UP = "up"
 DOWN = "down"
@@ -55,15 +62,25 @@ class Backend:
     # backend — the optimistic bump spreads the burst, and the next
     # poll (whose queue_depth then counts the routed jobs) resets it
     inflight: int = 0
+    # consecutive clean polls while DOWN (readmission hysteresis)
+    ok_streak: int = 0
+    # pending injected poll outcomes ("fail" entries), armed by the
+    # partition/flap fault kinds and consumed one per poll
+    fault_script: List[str] = field(default_factory=list)
 
     def score(self) -> float:
         """Lower routes sooner.  Sheds dominate: a backend whose own
-        admission control is refusing work must not be handed more."""
+        admission control is refusing work must not be handed more.
+        A backend with ANY consecutive poll failures scores behind
+        every clean backend (r21): a timeout and a refused connect
+        degrade routing weight identically and immediately, without
+        waiting for the drain threshold."""
         return (
             float(self.queue_depth)
             + float(self.running)
             + float(self.inflight)
             + 4.0 * min(float(self.sheds), 8.0)
+            + 1000.0 * float(self.failures)
         )
 
 
@@ -80,6 +97,7 @@ class BackendRegistry:
         timeout: float = 5.0,
         sticky_s: float = 300.0,
         sticky_slack: float = 2.0,
+        readmit_after: int = 2,
         log=None,
     ):
         if not addrs:
@@ -89,6 +107,11 @@ class BackendRegistry:
         }
         self.token = token
         self.fail_after = max(1, int(fail_after))
+        self.readmit_after = max(1, int(readmit_after))
+        # injected-fault sequence counters (PTT_FAULT sites "backend"
+        # and "conn"): every individual backend poll advances both
+        self._poll_n = 0
+        self._conn_n = 0
         self.timeout = timeout
         self.sticky_s = sticky_s
         self.sticky_slack = sticky_slack
@@ -135,17 +158,53 @@ class BackendRegistry:
         b.sheds = total("ptt_admission_shed_total")
         b.warmed = len(ping.get("warmed") or [])
 
-    def poll_once(self) -> List[Backend]:
-        """One health pass over every backend.  Returns the backends
-        that transitioned up -> down THIS pass (the dispatcher's
-        failover trigger fires exactly once per outage)."""
+    def poll_once(self) -> Tuple[List[Backend], List[Backend]]:
+        """One health pass over every backend.  Returns
+        ``(newly_down, newly_up)``: the backends that transitioned
+        up -> down this pass (the dispatcher's failover trigger
+        fires exactly once per outage) and the ones readmitted this
+        pass after ``readmit_after`` consecutive clean polls (the
+        dispatcher's lost-job reconciliation trigger).
+
+        Injected network faults (PTT_FAULT, r21) are realized here:
+        ``partition@backend:N`` arms ``fail_after`` consecutive
+        injected poll failures on the N-th polled backend (enough to
+        drain it — the backend stays alive); ``flap@backend:N`` arms
+        a die/return cycle (drain, one clean poll, drain again, one
+        clean poll) that only hysteresis survives without a second
+        failover; ``slow@conn:N`` stalls the N-th outbound poll past
+        the timeout — a hung backend, exercising the same failure
+        path as a refused connect."""
         newly_down: List[Backend] = []
+        newly_up: List[Backend] = []
         for b in list(self.backends.values()):
+            self._poll_n += 1
+            hits = faults.poll("backend", self._poll_n)
+            if "partition" in hits:
+                b.fault_script.extend(["fail"] * self.fail_after)
+            if "flap" in hits:
+                b.fault_script.extend(
+                    ["fail"] * self.fail_after + ["ok"]
+                    + ["fail"] * self.fail_after + ["ok"]
+                )
             try:
+                if b.fault_script and b.fault_script.pop(0) == "fail":
+                    raise OSError(
+                        f"injected partition: {b.addr} unreachable "
+                        "(PTT_FAULT)"
+                    )
+                self._conn_n += 1
+                if "slow" in faults.poll("conn", self._conn_n):
+                    time.sleep(self.timeout)
+                    raise TimeoutError(
+                        f"injected slow poll: {b.addr} exceeded "
+                        f"{self.timeout:.1f}s (PTT_FAULT)"
+                    )
                 self._poll_backend(b)
             except (OSError, protocol.ProtocolError, ValueError) as e:
                 with self._lock:
                     b.failures += 1
+                    b.ok_streak = 0
                     if b.failures >= self.fail_after and b.state == UP:
                         b.state = DOWN
                         newly_down.append(b)
@@ -156,12 +215,23 @@ class BackendRegistry:
                 continue
             with self._lock:
                 if b.state == DOWN:
-                    self._log(f"fleet: backend {b.addr} rejoined")
-                b.state = UP
+                    # readmission hysteresis: one clean poll in the
+                    # middle of a flap cycle is not health
+                    b.ok_streak += 1
+                    if b.ok_streak < self.readmit_after:
+                        b.failures = 0
+                        continue
+                    self._log(
+                        f"fleet: backend {b.addr} rejoined after "
+                        f"{b.ok_streak} consecutive clean polls"
+                    )
+                    b.state = UP
+                    newly_up.append(b)
                 b.failures = 0
+                b.ok_streak = 0
                 b.last_ok_unix = time.time()
                 b.inflight = 0  # the fresh queue_depth counts them
-        return newly_down
+        return newly_down, newly_up
 
     # ------------------------------------------------------- routing
 
@@ -207,3 +277,31 @@ class BackendRegistry:
         """addr -> state, for the ``ptt_fleet_backends`` gauge."""
         with self._lock:
             return {a: b.state for a, b in self.backends.items()}
+
+    # ------------------------------------------- sticky persistence
+
+    def sticky_snapshot(self) -> Dict[str, List]:
+        """JSON-friendly copy of the per-tenant stickiness table —
+        persisted with the job table so a restarted dispatcher
+        (``--recover``) keeps warm locality instead of re-spreading
+        every tenant cold (r21)."""
+        with self._lock:
+            return {
+                t: [addr, placed]
+                for t, (addr, placed) in self._sticky.items()
+            }
+
+    def restore_sticky(self, snap) -> None:
+        """Reload a :meth:`sticky_snapshot`; entries naming unknown
+        backends are dropped (the fleet may have been reconfigured
+        across the restart)."""
+        if not isinstance(snap, dict):
+            return
+        with self._lock:
+            for tenant, pair in snap.items():
+                try:
+                    addr, placed = pair
+                except (TypeError, ValueError):
+                    continue
+                if addr in self.backends:
+                    self._sticky[str(tenant)] = (addr, float(placed))

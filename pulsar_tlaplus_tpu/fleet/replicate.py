@@ -32,6 +32,7 @@ so the digest-diff logic exists exactly once.
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
 import os
 from typing import Dict, List, Optional, Tuple
@@ -191,20 +192,52 @@ def replicate_artifact(
     wire = 0
     sig = manifest.get("config_sig")
     for rel in need:
-        pull = protocol.request(
-            src_addr, "warm_pull", timeout=timeout,
-            config_sig=sig, rel=rel, **_auth(token),
+        want = ((manifest.get("files") or {}).get(rel) or {}).get(
+            "sha256"
         )
-        if not pull.get("ok"):
-            return {
-                "status": f"pull_refused: {pull.get('error')}",
-                "blobs": 0, "wire_bytes": 0,
-            }
+        pull = None
+        # digest-verify the pulled bytes against the MANIFEST before
+        # they ride to the peer (r21): a blob corrupted in flight or
+        # torn by a partition is quarantined (dropped, never pushed)
+        # and re-pulled once — the peer's install would catch it too,
+        # but failing the whole artifact there costs a full re-sieve
+        for attempt in (0, 1):
+            pull = protocol.request(
+                src_addr, "warm_pull", timeout=timeout,
+                config_sig=sig, rel=rel, **_auth(token),
+            )
+            if not pull.get("ok"):
+                return {
+                    "status": f"pull_refused: {pull.get('error')}",
+                    "blobs": 0, "wire_bytes": 0,
+                }
+            wire += int(pull.get("wire_bytes") or 0)
+            if want is None:
+                break
+            try:
+                data = decode_blob(
+                    str(pull.get("data", "")),
+                    int(pull.get("raw_bytes", 0)),
+                )
+                got = hashlib.sha256(data).hexdigest()
+            except Exception:  # noqa: BLE001 — any decode failure
+                #                (bad base64, zlib error, torn blob)
+                #                is the same verdict: not the bytes
+                #                the manifest promised
+                got = None
+            if got == want:
+                break
+            pull = None
+            if attempt == 1:
+                return {
+                    "status": f"pull_corrupt: {rel!r} digest "
+                    "mismatch twice (quarantined, nothing pushed)",
+                    "blobs": 0, "wire_bytes": wire,
+                }
         blobs[rel] = {
             "data": pull.get("data"),
             "raw_bytes": pull.get("raw_bytes"),
         }
-        wire += int(pull.get("wire_bytes") or 0)
     push = protocol.request(
         dst_addr, "warm_push", timeout=timeout,
         manifest=manifest, blobs=blobs, **_auth(token),

@@ -76,6 +76,12 @@ GATE_DIRECTIONS: Dict[str, str] = {
     "fleet_jobs_per_sec": "higher",
     "fleet_route_ms": "lower",
     "fleet_replicated_wire_bytes": "lower",
+    # fleet survivability (r21, bench_schema 11): how long a drained
+    # backend's queued jobs take to land elsewhere, and how long a
+    # rejoined backend's lost jobs take to deliver their real result
+    # — both lower-better service-tier latencies
+    "fleet_failover_ms": "lower",
+    "fleet_reconcile_ms": "lower",
 }
 # the machine-independent subset — the tier-1 gate's default
 DETERMINISTIC_GATE_KEYS = (
@@ -91,6 +97,12 @@ SPILL_GATE_KEYS = ("spill_bytes_per_state",)
 # the identical walk stream): the tier-1 sim gate's explicit key set
 # (tests/test_sim.py) against the committed sim baseline
 SIM_GATE_KEYS = ("steps_per_state",)
+# the fleet-path gate subset (r21): the tier-1 fleet gate's explicit
+# key set (tests/test_fleet.py) against the committed mini
+# fleet-bench baseline.  Wire bytes are codec-deterministic for a
+# fixed workload; the latency keys ride along so a committed
+# baseline documents the survivability envelope too.
+FLEET_GATE_KEYS = ("fleet_replicated_wire_bytes",)
 
 
 def _digest(values: dict) -> str:

@@ -336,14 +336,21 @@ def _fleet_families(
     repl_bytes: Dict[str, float],
     failovers: Dict[str, float],
     resubmitted: Dict[str, float],
+    reconciled: Optional[Dict[str, float]] = None,
+    partitions: Optional[Dict[str, float]] = None,
+    recoveries: float = 0.0,
+    persist_failures: float = 0.0,
 ) -> List[Family]:
     """The r20 fleet-dispatcher families (docs/fleet.md): backend
     health by address, submit placements by backend and routing
     reason (``sticky`` / ``least_loaded`` / ``only_backend``),
     cumulative placement latency, the replication sieve's shipped
     blobs + delta-compressed wire bytes by destination, and
-    failover drains + the queued jobs they resubmitted.  Identically
-    named from the live dispatcher and a stream tail."""
+    failover drains + the queued jobs they resubmitted.  r21 adds
+    the survivability families: lost jobs reconciled by a rejoined
+    backend, partition windows closed, ``--recover`` passes, and
+    fleet_jobs.json persist failures.  Identically named from the
+    live dispatcher and a stream tail."""
     f_back = Family(
         "ptt_fleet_backends", "gauge",
         "Registered backends by address and health state",
@@ -386,9 +393,33 @@ def _fleet_families(
     )
     for addr, n in sorted(resubmitted.items()):
         f_resub.add(n, {"backend": addr})
+    f_recon = Family(
+        "ptt_fleet_reconciled_total", "counter",
+        "Lost jobs answered for by a rejoined backend (lost -> "
+        "real state), by backend",
+    )
+    for addr, n in sorted((reconciled or {}).items()):
+        f_recon.add(n, {"backend": addr})
+    f_part = Family(
+        "ptt_fleet_partitions_total", "counter",
+        "Partition windows closed (a drained backend rejoined "
+        "still holding its jobs), by backend",
+    )
+    for addr, n in sorted((partitions or {}).items()):
+        f_part.add(n, {"backend": addr})
+    f_recov = Family(
+        "ptt_fleet_recoveries_total", "counter",
+        "dispatch --recover passes (job table rebuilt from the "
+        "backends' authoritative tables)",
+    ).add(recoveries or None)
+    f_persist = Family(
+        "ptt_fleet_persist_failures_total", "counter",
+        "fleet_jobs.json persists that failed BOTH attempts "
+        "(the dispatcher kept serving memory-only)",
+    ).add(persist_failures or None)
     return [
         f_back, f_routes, f_route_s, f_blobs, f_bytes, f_fail,
-        f_resub,
+        f_resub, f_recon, f_part, f_recov, f_persist,
     ]
 
 
@@ -410,6 +441,10 @@ def fleet_metrics(dispatcher, uptime_s: Optional[float] = None) -> List[Family]:
         snap["backends"], snap["routes"], snap["route_s"],
         snap["repl_blobs"], snap["repl_bytes"], snap["failovers"],
         snap["resubmitted"],
+        reconciled=snap.get("reconciled"),
+        partitions=snap.get("partitions"),
+        recoveries=snap.get("recoveries", 0.0),
+        persist_failures=snap.get("persist_failures", 0.0),
     )
 
 
@@ -574,6 +609,11 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     fleet_bytes: Dict[str, float] = {}
     fleet_failovers: Dict[str, float] = {}
     fleet_resub: Dict[str, float] = {}
+    # fleet survivability stream (r21): reconciled lost jobs,
+    # partition windows closed, --recover passes
+    fleet_recon: Dict[str, float] = {}
+    fleet_part: Dict[str, float] = {}
+    fleet_recoveries = 0.0
     for e in events:
         ev = e.get("event")
         if ev == "route":
@@ -600,6 +640,16 @@ def stream_metrics(events: List[dict]) -> List[Family]:
                 + float(e.get("resubmitted", 0) or 0)
             )
             fleet_backends[addr] = "down"
+        elif ev == "reconcile":
+            addr = str(e.get("backend", "?"))
+            fleet_recon[addr] = fleet_recon.get(addr, 0) + 1
+            fleet_backends[addr] = "up"
+        elif ev == "partition":
+            addr = str(e.get("backend", "?"))
+            fleet_part[addr] = fleet_part.get(addr, 0) + 1
+            fleet_backends[addr] = "up"  # rejoined when this fired
+        elif ev == "recover":
+            fleet_recoveries += 1
         if ev == "warm":
             # mirror the live daemon's counting points exactly: a cold
             # PLAN is final (the job never reaches install), a
@@ -710,11 +760,14 @@ def stream_metrics(events: List[dict]) -> List[Family]:
         fams += _warm_families(warm_counts)
     if (
         fleet_backends or fleet_routes or fleet_blobs
-        or fleet_failovers
+        or fleet_failovers or fleet_recon or fleet_recoveries
     ):
         fams += _fleet_families(
             fleet_backends, fleet_routes, fleet_route_s,
             fleet_blobs, fleet_bytes, fleet_failovers, fleet_resub,
+            reconciled=fleet_recon,
+            partitions=fleet_part,
+            recoveries=fleet_recoveries,
         )
 
     # daemon streams additionally carry the job lifecycle

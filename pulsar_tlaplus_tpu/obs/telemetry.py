@@ -133,10 +133,20 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # record per artifact sieve pass (what shipped vs what the peer
 # already held), one ``failover`` record per backend drain (how many
 # queued jobs were resubmitted elsewhere).
+# v14 (round 21, fleet survivability): three more dispatcher events —
+# one ``reconcile`` record per lost job whose rejoined backend
+# answered for it (which backend, which job, the real terminal state
+# that replaced ``lost``), one ``partition`` record per drained
+# backend that rejoined still holding its jobs (the signature of a
+# partition window closing, as opposed to a restart), and one
+# ``recover`` record per ``dispatch --recover`` pass (how many
+# persisted jobs were confirmed / adopted / typed lost against the
+# backends' authoritative job tables, and whether a torn
+# fleet_jobs.json was quarantined first).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -229,6 +239,14 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("replicate", "wire_bytes"): 13,
     ("failover", "backend"): 13,
     ("failover", "resubmitted"): 13,
+    # v14 (round 21): the fleet survivability events — NEW at v14, so
+    # gating their required fields keeps every committed v13-and-older
+    # stream using these names validator-clean.
+    ("reconcile", "backend"): 14,
+    ("reconcile", "job_id"): 14,
+    ("reconcile", "state"): 14,
+    ("partition", "backend"): 14,
+    ("recover", "jobs"): 14,
     ("admission", "action"): 10,
     ("admission", "tenant"): 10,
     ("auth", "action"): 10,
@@ -354,6 +372,20 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "route": ("backend", "tenant"),
     "replicate": ("src", "dst", "blobs", "wire_bytes"),
     "failover": ("backend", "resubmitted"),
+    # fleet survivability (r21, fleet/dispatcher.py): ``reconcile`` is
+    # one lost job answered for by its rejoined backend — ``state`` is
+    # the REAL state that replaced ``lost`` (done delivers the
+    # backend's finished result; running resumes watch relay);
+    # ``partition`` is one drained backend rejoining while still
+    # holding its jobs (a partition window closed — a restarted
+    # backend would have forgotten them); ``recover`` is one
+    # ``dispatch --recover`` pass — persisted jobs reconciled against
+    # every backend's authoritative job table (confirmed / adopted /
+    # lost counts, plus whether a torn fleet_jobs.json was
+    # quarantined first)
+    "reconcile": ("backend", "job_id", "state"),
+    "partition": ("backend",),
+    "recover": ("jobs",),
 }
 
 

@@ -304,7 +304,15 @@ class ServiceClient:
         CONSECUTIVE failures — a reconnect that streams fresh events
         replenishes it, so a long watch on a flaky link survives as
         long as it keeps making progress.  Retries exhausted raise
-        :class:`TransportError`."""
+        :class:`TransportError`.
+
+        A mid-stream ``backend_unavailable`` (r21) is transient too:
+        a fleet dispatcher whose backend died mid-relay fails the job
+        over within one health interval, and the reconnect resumes
+        the relay from the NEW owner (the dispatcher restarts a
+        failed-over stream from offset 0; the (run_id, seq) join here
+        drops the replayed prefix, so failover costs duplicates on
+        the wire but never a dropped or double-yielded event)."""
         seen: dict = {}  # run_id -> highest seq yielded
         last_pos = 0  # server file offset: reconnects RESUME there
 
@@ -348,7 +356,7 @@ class ServiceClient:
                 raise protocol.ProtocolError(
                     "watch stream ended without a done record"
                 )
-            except _TRANSIENT as e:
+            except _TRANSIENT + (BackendUnavailable,) as e:
                 if progressed:
                     # fresh events flowed since the last failure:
                     # this is a new incident, not attempt N+1 of the

@@ -155,6 +155,11 @@ class Job:
             "run_ids": list(self.run_ids),
             "wall_s": round(self.wall_s, 3),
         }
+        if self.submit_id:
+            # the idempotency key joins this backend-side record to
+            # the dispatcher's routing table: `dispatch --recover`
+            # reconciles against the listing by submit_id (r21)
+            s["submit_id"] = self.submit_id
         if self.warm_mode is not None:
             s["warm_mode"] = self.warm_mode
             s["warm_reason"] = self.warm_reason

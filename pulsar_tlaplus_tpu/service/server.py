@@ -509,7 +509,11 @@ class ServiceDaemon:
         else:
             # the listing is tenant-scoped over TCP: job ids are the
             # capability handles guarding result/cancel/watch, and a
-            # global listing would hand every tenant everyone else's
+            # global listing would hand every tenant everyone else's.
+            # The reserved fleet tenant sees everything (r21): this
+            # listing is the backend's authoritative job table, and
+            # `dispatch --recover` rebuilds its routing state from it
+            # — the same trust level the warm_* verbs already grant.
             tenant = req.get("_tenant")
             protocol.send_json(
                 w,
@@ -517,7 +521,8 @@ class ServiceDaemon:
                     "ok": True,
                     "jobs": self.sched.snapshot(
                         None
-                        if tenant == authmod.LOCAL_TENANT
+                        if tenant
+                        in (authmod.LOCAL_TENANT, authmod.FLEET_TENANT)
                         else tenant
                     ),
                 },

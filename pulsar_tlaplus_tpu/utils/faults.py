@@ -27,6 +27,19 @@ variable into synthetic faults fired at named host-side sites:
                                        (cold-fallback drill, r19)
     PTT_FAULT=torn@warmwrite:2         warm-artifact write 2 publishes
                                        half a manifest (quarantine drill)
+    PTT_FAULT=partition@backend:3      fleet backend poll 3's backend
+                                       turns unreachable from the
+                                       dispatcher (alive, partitioned)
+                                       for a drain-length window (r21)
+    PTT_FAULT=slow@conn:2              the dispatcher's outbound
+                                       connection 2 stalls past the
+                                       poll timeout (hung-backend
+                                       drill, r21)
+    PTT_FAULT=flap@backend:5           backend poll 5's backend starts
+                                       a die/return cycle (drain, one
+                                       clean poll, drain again —
+                                       the readmission-hysteresis
+                                       drill, r21)
     PTT_FAULT=oom@level:7,kill@level:9 comma-separated specs compose
 
 Syntax: ``kind@site:count`` — ``site`` is a counter the engines
@@ -39,7 +52,11 @@ accepted-connection sequence, ``line`` = the daemon's sent-protocol-
 line sequence, ``persist`` = the scheduler's queue.json snapshot
 sequence, ``spill`` = the tiered store's spill-write sequence,
 ``warm`` = the warm store's artifact-verification sequence and
-``warmwrite`` its artifact-write sequence — r19),
+``warmwrite`` its artifact-write sequence — r19; since round 21 the
+FLEET layer counts too: ``backend`` = the registry's per-backend
+health-poll sequence (every individual backend poll advances it) and
+``conn`` doubles as the dispatcher's outbound-connection sequence
+for ``slow``),
 ``count`` the value at which the spec fires.  Each spec fires AT MOST ONCE per process: a run that recovers
 from an injected OOM and re-expands the same level must not be
 re-injected forever (mirroring the real world, where the recovery's
@@ -92,6 +109,16 @@ KINDS = (
     # publishes half a manifest; kill dies between frame and
     # manifest — the startup-sweep quarantine drill)
     "corrupt",
+    # network-level kinds (r21, fleet/registry.py): `partition@
+    # backend:N` makes the N-th polled backend unreachable from the
+    # dispatcher for a drain-length window (the backend itself stays
+    # alive and keeps running its jobs — the reconciliation drill);
+    # `slow@conn:N` stalls the dispatcher's N-th outbound poll past
+    # its timeout (a hung backend, not a dead one); `flap@backend:N`
+    # starts a die/return cycle on the N-th polled backend (the
+    # readmission-hysteresis drill).  All three are realized by the
+    # registry's health loop, not here.
+    "partition", "slow", "flap",
 )
 
 # parse cache keyed on the raw env value + set of fired spec indexes

@@ -769,6 +769,502 @@ def run_fleet_chaos(
     return report
 
 
+def build_fleet_schedule(seed: int) -> dict:
+    """Seeded fleet-survivability schedule for the v2 drill: the
+    per-backend poll indices where the partition window and the flap
+    cycle arm (realized by the restarted dispatcher's registry via
+    PTT_FAULT ``partition@backend`` / ``flap@backend``), the
+    fleet_jobs.json snapshot that hits a synthetic ENOSPC, and the
+    server-sent protocol line torn mid-replication.  Same contract as
+    :func:`build_schedule`: one seed, one schedule, forever."""
+    rng = random.Random(seed)
+    return {
+        "partition_poll": rng.randint(4, 8),
+        "flap_poll": rng.randint(14, 18),
+        "enospc_n": rng.randint(1, 3),
+        "torn_line": rng.randint(40, 120),
+    }
+
+
+def _global_poll_n(backend_idx: int, per_backend_poll: int,
+                   n_backends: int = 2) -> int:
+    """The registry's global ``_poll_n`` value for backend
+    ``backend_idx``'s ``per_backend_poll``-th poll (backends are
+    polled in config order, every backend once per pass) — how a
+    seeded per-backend schedule becomes a ``PTT_FAULT`` count."""
+    return n_backends * (per_backend_poll - 1) + backend_idx + 1
+
+
+def _spawn_dispatcher(
+    state_dir: str, backends, recover: bool = False,
+    fault: Optional[str] = None, log=lambda m: None,
+):
+    """A REAL ``cli.py dispatch`` process (the kill -9 target).  The
+    injected fleet faults ride PTT_FAULT in its environment; the
+    ready line on stdout gates return (by then ``--recover`` has
+    already rebuilt the job table)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if fault:
+        env["PTT_FAULT"] = fault
+    else:
+        env.pop("PTT_FAULT", None)
+    cmd = [
+        sys.executable, "-m", "pulsar_tlaplus_tpu.cli", "dispatch",
+        state_dir,
+    ]
+    for a in backends:
+        cmd += ["--backend", a]
+    cmd += [
+        "--health-interval", "0.2", "--fail-after", "2",
+        "--backend-timeout", "5.0", "--readmit-after", "2",
+        "--hold-s", "15.0",
+    ]
+    if recover:
+        cmd.append("--recover")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=ROOT, env=env,
+    )
+    line = proc.stdout.readline()
+    if "dispatching on" not in line:
+        proc.kill()
+        raise ChaosFailure(
+            f"dispatcher never came up (first line {line!r})"
+        )
+    log(
+        f"dispatcher pid {proc.pid} up"
+        + (" (recovered)" if recover else "")
+        + (f" [PTT_FAULT={fault}]" if fault else "")
+    )
+    return proc
+
+
+def run_fleet_chaos_v2(
+    state_dir: str,
+    seed: int = 0,
+    schedule: Optional[dict] = None,
+    slice_s: float = 0.5,
+    timeout_s: float = 600.0,
+    geom: Optional[dict] = None,
+    solo=None,
+    pool=None,
+    clients: int = 2,
+    jobs_per_client: int = 1,
+    log=lambda m: print(f"chaos: {m}", file=sys.stderr, flush=True),
+) -> dict:
+    """The fleet SURVIVABILITY drill (ISSUE 17, ``--fleet`` v2).
+
+    Two in-process backends; the dispatcher is a real ``cli.py
+    dispatch`` subprocess so it can genuinely be killed with -9.  The
+    seeded schedule (:func:`build_fleet_schedule`) drives:
+
+    1. **kill -9 + --recover**: concurrent retrying clients submit
+       through the dispatcher; once every submit is acked the
+       dispatcher is killed -9 and restarted with ``--recover`` (plus
+       an injected ``enospc@persist``) — every acked job must appear
+       exactly once in the rebuilt table, a retried ``submit_id``
+       must dedup to the SAME job across the crash, and every job
+       must finish state-for-state solo-exact.
+    2. **partition + lost-job reconciliation**: a long sim job plus a
+       check job land on one backend; the dispatcher is killed -9
+       again and restarted with a partition window armed against that
+       backend (and a flap cycle against the other).  The drain types
+       the running jobs ``lost``; the rejoin reconciles them —
+       ``ptt_fleet_partitions_total`` counts the closed window, at
+       least one job carries the ``reconciled`` marker, the check job
+       still delivers the backend's real (solo-exact) result, and the
+       flapping backend fails over exactly ONCE (hysteresis held).
+    3. **torn replication**: a truncated probe replicates with a
+       seeded torn server line armed — afterwards every artifact on
+       every backend verifies digest-clean and a sweep finds nothing
+       (mid-replication faults leave only verified-or-quarantined
+       artifacts).
+
+    Afterwards: no acked job lost or double-run, and the dispatcher's
+    appended multi-incarnation stream plus both backend streams are
+    v14-validator-clean.  Raises :class:`ChaosFailure` on any broken
+    invariant."""
+    import signal as signalmod
+
+    from pulsar_tlaplus_tpu.obs import metrics as obs_metrics
+    from pulsar_tlaplus_tpu.service.scheduler import (
+        CheckerPool,
+        ServiceConfig,
+    )
+    from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+    from pulsar_tlaplus_tpu.utils import faults
+
+    geom = dict(geom or GEOM_FAST)
+    sched = dict(schedule or build_fleet_schedule(seed))
+    os.makedirs(state_dir, exist_ok=True)
+    cfg_dir = os.path.join(state_dir, "cfgs")
+    os.makedirs(cfg_dir, exist_ok=True)
+    comp_cfg = os.path.join(cfg_dir, "small_compaction.cfg")
+    with open(comp_cfg, "w") as f:
+        f.write(SMALL_COMPACTION_CFG)
+
+    report: dict = {"seed": seed, "schedule": sched}
+    configs = [
+        ServiceConfig(
+            state_dir=os.path.join(state_dir, f"backend{i}"),
+            slice_s=slice_s,
+            **geom,
+        )
+        for i in range(2)
+    ]
+    pool0 = pool or CheckerPool(configs[0])
+    if solo is None:
+        log("computing the solo baseline (pre-fleet, same geometry)")
+        solo = _solo_results(
+            pool0, {"compaction": ("compaction", comp_cfg)}
+        )["compaction"]
+    daemons = [
+        ServiceDaemon(
+            configs[0], pool=pool0,
+            log=lambda m: log(f"[backend0] {m}"),
+        ),
+        ServiceDaemon(
+            configs[1], log=lambda m: log(f"[backend1] {m}"),
+        ),
+    ]
+    addrs = tuple(c.socket_path for c in configs)
+    disp_dir = os.path.join(state_dir, "dispatch")
+    disp_sock = os.path.join(disp_dir, "dispatch.sock")
+    proc = None
+    prev_fault = os.environ.get("PTT_FAULT")
+
+    def metrics_samples(cl):
+        samples, _ = obs_metrics.parse_exposition(cl.metrics())
+        return samples
+
+    def counter(samples, family, addr=None):
+        out = 0.0
+        for labels, value in samples.get(family, []):
+            if addr is not None and labels.get("backend") != addr:
+                continue
+            out += value
+        return out
+
+    try:
+        for d in daemons:
+            d.start()
+
+        # ---- phase 1: acked submits survive kill -9 + --recover ----
+        proc = _spawn_dispatcher(disp_dir, addrs, log=log)
+        cl = ServiceClient(
+            disp_sock, timeout=timeout_s, retries=8,
+            rng=random.Random(seed ^ 0xF1EE7),
+        )
+        acked: List[tuple] = []  # (submit_id, job_id)
+        errors: List[str] = []
+        lock = threading.Lock()
+
+        def client_body(ci: int) -> None:
+            ccl = ServiceClient(
+                disp_sock, timeout=timeout_s, retries=8,
+                rng=random.Random(seed * 1000 + ci),
+            )
+            for k in range(jobs_per_client):
+                sid = f"v2-c{ci}-j{k}"
+                try:
+                    jid = ccl.submit(
+                        "compaction", comp_cfg, invariants=[],
+                        submit_id=sid, warm=False,
+                    )
+                    with lock:
+                        acked.append((sid, jid))
+                except Exception as e:  # noqa: BLE001 — collected
+                    with lock:
+                        errors.append(f"client {ci} job {k}: {e!r}")
+
+        threads = [
+            threading.Thread(target=client_body, args=(ci,))
+            for ci in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s)
+        if errors:
+            raise ChaosFailure(f"client errors: {errors}")
+        log(f"{len(acked)} submit(s) acked; killing the dispatcher -9")
+        proc.send_signal(signalmod.SIGKILL)
+        proc.wait(30.0)
+
+        proc = _spawn_dispatcher(
+            disp_dir, addrs, recover=True,
+            fault=f"enospc@persist:{sched['enospc_n']}", log=log,
+        )
+        table = {j["job_id"]: j for j in cl.status()}
+        for sid, jid in acked:
+            if jid not in table:
+                raise ChaosFailure(
+                    f"acked job {jid} ({sid}) missing after "
+                    "kill -9 + --recover"
+                )
+        if len(table) != len(acked):
+            raise ChaosFailure(
+                f"recovered table has {len(table)} job(s) for "
+                f"{len(acked)} acked submit(s) — a job was "
+                "double-recorded"
+            )
+        # exactly-once across the crash: a client retry with the same
+        # submit_id must dedup to the SAME job, not enqueue a second
+        for sid, jid in acked:
+            again = cl.submit(
+                "compaction", comp_cfg, invariants=[],
+                submit_id=sid, warm=False,
+            )
+            if again != jid:
+                raise ChaosFailure(
+                    f"submit_id {sid} resolved to {again} after the "
+                    f"crash (acked as {jid}) — dedup broke"
+                )
+        for sid, jid in acked:
+            r = cl.wait(jid, timeout=timeout_s)
+            if r.get("state") != "done" or not r.get("result"):
+                raise ChaosFailure(
+                    f"recovered job {jid} ended {r.get('state')}: "
+                    f"{r.get('error')}"
+                )
+            _assert_parity(r["result"], solo, f"recovered/{jid}")
+        # the injected ENOSPC was absorbed by the retry-once path
+        pong = cl.ping()
+        if pong.get("persist_failures", 0) != 0:
+            raise ChaosFailure(
+                "the single injected enospc@persist leaked into "
+                f"persist_failures={pong.get('persist_failures')} "
+                "(the retry-once path should have absorbed it)"
+            )
+        report["recovered"] = len(acked)
+        log(f"phase 1 PASS: {len(acked)} acked job(s) exactly-once")
+
+        # ---- phase 2: partition window + lost-job reconciliation ---
+        js_sub = cl.submit(
+            "compaction", comp_cfg, mode="simulate",
+            sim=dict(
+                n_walkers=64, depth=32, segment_len=8,
+                max_steps=1 << 22, seed=seed,
+            ),
+            warm=False, submit_id="v2-sim", full=True,
+        )
+        js, target = js_sub["job_id"], js_sub["backend"]
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if cl.status(js).get("state") == "running":
+                break
+            time.sleep(0.1)
+        else:
+            raise ChaosFailure("sim job never started")
+        jl_sub = cl.submit(
+            "compaction", comp_cfg, invariants=[], warm=False,
+            submit_id="v2-lost", full=True,
+        )
+        jl = jl_sub["job_id"]
+        if jl_sub["backend"] != target:
+            raise ChaosFailure(
+                f"check job routed to {jl_sub['backend']}, not the "
+                f"sticky sim owner {target} (stickiness broken)"
+            )
+        # both jobs claimed (time-slicing) so the drain types them
+        # LOST, not queued-resubmittable
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if cl.status(jl).get("state") in ("running", "suspended"):
+                break
+            time.sleep(0.1)
+        else:
+            raise ChaosFailure("check job never claimed a slice")
+        log(f"sim + check job running on {target}; killing -9 again")
+        proc.send_signal(signalmod.SIGKILL)
+        proc.wait(30.0)
+
+        ti = addrs.index(target)
+        fault = ",".join([
+            # partition the job-holding backend...
+            "partition@backend:"
+            f"{_global_poll_n(ti, sched['partition_poll'])}",
+            # ...and flap the other one (hysteresis must hold it to
+            # exactly one failover for the whole die/return cycle)
+            "flap@backend:"
+            f"{_global_poll_n(1 - ti, sched['flap_poll'])}",
+        ])
+        proc = _spawn_dispatcher(
+            disp_dir, addrs, recover=True, fault=fault, log=log,
+        )
+        # wait for the partition window to close: the rejoined
+        # backend held its jobs, so the partition counter ticks
+        other = addrs[1 - ti]
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            samples = metrics_samples(cl)
+            if (
+                counter(
+                    samples, "ptt_fleet_partitions_total", target
+                ) >= 1
+                and counter(
+                    samples, "ptt_fleet_failovers_total", other
+                ) >= 1
+                and all(
+                    s == "up" for s in cl.ping()["backends"].values()
+                )
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise ChaosFailure(
+                "partition window never closed (no partition count "
+                f"for {target} / no flap failover for {other}): "
+                f"{metrics_samples(cl)}"
+            )
+        samples = metrics_samples(cl)
+        if counter(samples, "ptt_fleet_reconciled_total", target) < 1:
+            raise ChaosFailure(
+                f"rejoined backend {target} reconciled no lost jobs"
+            )
+        if counter(samples, "ptt_fleet_partitions_total", other) != 0:
+            raise ChaosFailure(
+                f"flapping backend {other} (no jobs held) was "
+                "counted as a partition"
+            )
+        if counter(samples, "ptt_fleet_failovers_total", other) != 1:
+            raise ChaosFailure(
+                f"flap cycle on {other} caused "
+                f"{counter(samples, 'ptt_fleet_failovers_total', other):.0f} "
+                "failovers — readmission hysteresis thrashed"
+            )
+        if counter(samples, "ptt_fleet_recoveries_total") < 1:
+            raise ChaosFailure("recover() never counted a recovery")
+        # the reconciled lost job delivers the backend's REAL result:
+        # same backend run, solo-exact — never a silent re-run
+        rl = cl.wait(jl, timeout=timeout_s)
+        if rl.get("state") != "done" or not rl.get("result"):
+            raise ChaosFailure(
+                f"reconciled check job ended {rl.get('state')}: "
+                f"{rl.get('error')}"
+            )
+        _assert_parity(rl["result"], solo, f"reconciled/{jl}")
+        listing = {j["job_id"]: j for j in cl.status()}
+        reconciled_jobs = [
+            jid for jid, j in listing.items() if j.get("reconciled")
+        ]
+        if not reconciled_jobs:
+            raise ChaosFailure(
+                "no job carries the reconciled marker after the "
+                "partition window closed"
+            )
+        report["reconciled_jobs"] = len(reconciled_jobs)
+        report["partitions"] = int(
+            counter(samples, "ptt_fleet_partitions_total", target)
+        )
+        cl.cancel(js)
+        log(
+            f"phase 2 PASS: partition on {target} reconciled "
+            f"{len(reconciled_jobs)} job(s), flap on {other} held to "
+            "one failover"
+        )
+
+        # ---- phase 3: torn replication leaves only verified state --
+        os.environ["PTT_FAULT"] = f"torn@line:{sched['torn_line']}"
+        faults.reset()
+        # warm stays ON: the truncated probe must SAVE its artifact,
+        # or there is nothing for the torn window to replicate
+        jt_sub = cl.submit(
+            "compaction", comp_cfg, invariants=[], max_states=600,
+            submit_id="v2-trunc", full=True,
+        )
+        jt = jt_sub["job_id"]
+        rt = cl.wait(jt, timeout=timeout_s)
+        if (rt.get("result") or {}).get("status") != "truncated":
+            raise ChaosFailure(
+                f"truncation probe ended {rt.get('result')!r}"
+            )
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if counter(
+                metrics_samples(cl),
+                "ptt_fleet_replicated_wire_bytes_total",
+            ) > 0:
+                break
+            time.sleep(0.2)
+        else:
+            raise ChaosFailure("replication never shipped bytes")
+        report["replicated_wire_bytes"] = int(counter(
+            metrics_samples(cl),
+            "ptt_fleet_replicated_wire_bytes_total",
+        ))
+        # every artifact on every backend is digest-verified or gone
+        for i, d in enumerate(daemons):
+            ws = d.sched.warm_store
+            if ws is None:
+                continue
+            swept = ws.sweep()
+            if swept:
+                raise ChaosFailure(
+                    f"backend{i} store held unverifiable artifacts "
+                    f"after the torn-replication window: {swept}"
+                )
+            for adir, _man in ws.manifests():
+                ok, reason = ws.verify(adir)
+                if not ok:
+                    raise ChaosFailure(
+                        f"backend{i} artifact {adir} corrupt after "
+                        f"torn replication: {reason}"
+                    )
+        log(
+            "phase 3 PASS: torn-replication window left only "
+            f"verified artifacts "
+            f"({report['replicated_wire_bytes']} wire bytes)"
+        )
+
+        # ---- final: no acked job lost or double-run ----------------
+        listing = {j["job_id"]: j for j in cl.status()}
+        if any(
+            j.get("state") == "lost" for j in listing.values()
+        ):
+            raise ChaosFailure(
+                f"a job is still typed lost at drill end: {listing}"
+            )
+        want = len(acked) + 3  # + sim + v2-lost + v2-trunc
+        if len(listing) != want:
+            raise ChaosFailure(
+                f"job table has {len(listing)} entries, expected "
+                f"{want} — an acked submit was dropped or double-run"
+            )
+    finally:
+        if proc is not None:
+            try:
+                proc.send_signal(signalmod.SIGTERM)
+                proc.wait(30.0)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                proc.kill()
+        for d in daemons:
+            d.shutdown()
+        if prev_fault is None:
+            os.environ.pop("PTT_FAULT", None)
+        else:
+            os.environ["PTT_FAULT"] = prev_fault
+        faults.reset()
+
+    # ---- every stream v14-validator-clean (the dispatcher's file
+    # holds all three incarnations, appended — distinct run_ids) ----
+    stream_errors = _validate_streams(
+        [os.path.join(disp_dir, "dispatch.jsonl")]
+        + [c.telemetry_path for c in configs]
+    )
+    if stream_errors:
+        raise ChaosFailure(f"stream violations: {stream_errors}")
+    report["streams_validated"] = 3
+    log(
+        "PASS: kill -9 recovery exactly-once, partition reconciled, "
+        "flap hysteresis held, torn replication verified, "
+        f"{report['streams_validated']} stream(s) validator-clean"
+    )
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="service-layer chaos drill (seeded, reproducible)"
@@ -788,10 +1284,18 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument(
         "--fleet", action="store_true",
-        help="run the fleet drill instead: two backends behind a "
-        "dispatcher — warm replication, a mid-job backend kill, "
-        "failover resubmit, and a solo-exact warm restart on the "
-        "survivor (docs/fleet.md)",
+        help="run the fleet SURVIVABILITY drill (v2) instead: two "
+        "backends behind a real `ptt dispatch` subprocess — kill -9 "
+        "+ --recover exactly-once, a seeded partition window with "
+        "lost-job reconciliation, a flap held to one failover by "
+        "readmission hysteresis, and torn replication leaving only "
+        "verified artifacts (docs/fleet.md, Survivability)",
+    )
+    ap.add_argument(
+        "--fleet-v1", action="store_true",
+        help="run the original (ISSUE 16) fleet drill: warm "
+        "replication, a mid-job backend kill, failover resubmit, "
+        "and a solo-exact warm restart on the survivor",
     )
     args = ap.parse_args(argv)
     state_dir = args.state_dir
@@ -801,6 +1305,14 @@ def main(argv=None) -> int:
         state_dir = tempfile.mkdtemp(prefix="ptt_chaos_")
     try:
         if args.fleet:
+            run_fleet_chaos_v2(
+                state_dir,
+                seed=args.seed,
+                clients=args.clients,
+                jobs_per_client=args.jobs_per_client,
+                timeout_s=args.timeout,
+            )
+        elif args.fleet_v1:
             run_fleet_chaos(
                 state_dir, seed=args.seed, timeout_s=args.timeout
             )

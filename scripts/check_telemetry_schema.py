@@ -85,10 +85,19 @@ the tiered-store keys (``hbm_budget``, ``spill_bytes_per_state``,
 keys required), >= 10 additionally the fleet-tier keys
 (``fleet_backends``, ``fleet_jobs_per_sec``, ``fleet_route_ms``,
 ``fleet_replicated_wire_bytes`` — null on non-fleet runs, keys
-required).  r20: v13 streams additionally validate the dispatcher's
+required), >= 11 additionally the fleet survivability latencies
+(``fleet_failover_ms`` — drain detected to queued jobs landed
+elsewhere, ``fleet_reconcile_ms`` — rejoin detected to lost jobs
+answered for; null on non-fleet runs, keys required).  r20: v13
+streams additionally validate the dispatcher's
 ``route``/``replicate``/``failover`` events (FIELD_SINCE-gated) and
 the ``ptt_fleet_*`` families render identically from the live
-dispatcher and a stream scrape.
+dispatcher and a stream scrape.  r21: v14 streams additionally
+validate the survivability events — ``reconcile`` (backend, job_id,
+the real state that replaced ``lost``), ``partition`` (a drained
+backend rejoined still holding its jobs), ``recover`` (a ``dispatch
+--recover`` pass with its confirmed/adopted/lost counts) — all
+FIELD_SINCE-gated so committed v13-and-older streams stay clean.
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -155,6 +164,14 @@ BENCH_KEYS_V9 = BENCH_KEYS_V8 + ("walks_per_sec", "steps_per_state")
 BENCH_KEYS_V10 = BENCH_KEYS_V9 + (
     "fleet_backends", "fleet_jobs_per_sec", "fleet_route_ms",
     "fleet_replicated_wire_bytes",
+)
+# v11 (r21): the fleet survivability latencies — mean time from a
+# drain detected to its queued jobs landing elsewhere, and from a
+# rejoin detected to its lost jobs answered for (null on non-fleet
+# runs AND on fleet runs whose drill saw no drain/rejoin; the keys
+# themselves are required)
+BENCH_KEYS_V11 = BENCH_KEYS_V10 + (
+    "fleet_failover_ms", "fleet_reconcile_ms",
 )
 
 
@@ -385,7 +402,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 10:
+    if schema >= 11:
+        required = BENCH_KEYS_V11
+    elif schema >= 10:
         required = BENCH_KEYS_V10
     elif schema >= 9:
         required = BENCH_KEYS_V9

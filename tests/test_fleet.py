@@ -18,7 +18,7 @@ The acceptance bar (docs/fleet.md):
   fleet-era multi-writer mix: saves, peer-push installs, LRU cap).
 
 The slow-marked load test runs a 3-backend mixed-spec batch and emits
-a bench_schema-10 fleet artifact the validator and ledger accept.
+a bench_schema-11 fleet artifact the validator and ledger accept.
 """
 
 import json
@@ -238,6 +238,148 @@ def test_fleet_failover_drill_solo_exact(
     assert out["warm_mode"] in ("continue", "reseed")
 
 
+# ---- ledger gate: committed mini fleet-bench baseline (r21) ---------
+
+FLEET_PINNED = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "data", "mini_bench_fleet.jsonl",
+)
+
+# the baseline's identity strings: the ledger groups records by a hash
+# of the metric (config_key), so the committed baseline and the fresh
+# run must agree byte-for-byte or the gate finds no baseline at all
+FLEET_GATE_METRIC = (
+    "fleet replication economy: truncated small-compaction artifact "
+    "shipped to the non-owning peer (2 backends)"
+)
+FLEET_GATE_ENGINE = "fleet r21 (2 serve backends, sieve replication)"
+
+
+def build_fleet_gate_artifact(root, pool, cfg_path):
+    """The mini fleet bench the tier-1 gate pins: a 2-backend fleet
+    ships the truncated small-compaction probe's artifact to the peer
+    and reports the zlib wire bytes — codec-deterministic for the
+    fixed workload (``ledger.FLEET_GATE_KEYS``, lower is better).
+    Doubles as the generator for ``tests/data/mini_bench_fleet.jsonl``
+    (write ``ledger.record_from_bench(artifact, source=...)`` as one
+    JSON line)."""
+    import importlib.util
+
+    configs = [
+        _config(os.path.join(str(root), "b0"), slice_s=0.3),
+        _config(os.path.join(str(root), "b1"), slice_s=0.3),
+    ]
+    daemons = [
+        ServiceDaemon(configs[0], pool=pool),
+        ServiceDaemon(configs[1]),
+    ]
+    for d in daemons:
+        d.start()
+    disp = FleetDispatcher(FleetConfig(
+        state_dir=os.path.join(str(root), "disp"),
+        backends=tuple(c.socket_path for c in configs),
+        health_interval_s=0.2,
+    ))
+    disp.start()
+    try:
+        cl = ServiceClient(disp.config.socket_path, timeout=240.0)
+        probe = cl.submit(
+            "compaction", cfg_path, invariants=[], max_states=600,
+            submit_id="fleet-gate-probe", full=True,
+        )
+        done = cl.wait(probe["job_id"], timeout=600.0)
+        assert done["result"]["status"] == "truncated"
+        # both backends idle at submit time -> the tie breaks to b0
+        # (the warmed pool); the peer only installs, never compiles
+        wire = 0
+        deadline = time.monotonic() + 120.0
+        while not wire:
+            snap = disp.metrics_snapshot()
+            wire = int(sum(snap["repl_bytes"].values()))
+            if not wire:
+                assert time.monotonic() < deadline, (
+                    "replication never shipped"
+                )
+                time.sleep(0.1)
+    finally:
+        disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__
+            ))), "bench.py",
+        )
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    d = bench.artifact_skeleton()
+    d.update(
+        metric=FLEET_GATE_METRIC,
+        value=wire,
+        unit="bytes",
+        mode="fleet",
+        engine=FLEET_GATE_ENGINE,
+        stop_reason="done",
+        truncated=False,
+        fleet_backends=2,
+        fleet_replicated_wire_bytes=wire,
+    )
+    return d
+
+
+def test_fleet_ledger_gate_pinned_baseline(
+    tmp_path, pool, cfg_dir, checker_mod
+):
+    """The fleet tier-1 gate (r21 satellite): a fresh replication run
+    gates clean against the committed mini fleet-bench baseline on
+    ``fleet_replicated_wire_bytes``; an injected codec regression
+    (half again the bytes for the same warm coverage) fails."""
+    import shutil
+
+    from pulsar_tlaplus_tpu import cli
+    from pulsar_tlaplus_tpu.obs import ledger as ledgermod
+
+    path = str(tmp_path / "fleet_ledger.jsonl")
+    shutil.copy(FLEET_PINNED, path)
+    assert ledgermod.validate_ledger(path) == []
+
+    art = build_fleet_gate_artifact(
+        tmp_path / "gate", pool,
+        str(cfg_dir / "small_compaction.cfg"),
+    )
+    assert art["bench_schema"] == 11
+    errs = checker_mod.validate_bench_artifact(art, "fleet-gate")
+    assert errs == []
+    apath = str(tmp_path / "fleet_gate.json")
+    with open(apath, "w") as f:
+        f.write(json.dumps(art))
+    assert cli.main(["ledger", "--ledger", path, "add", apath]) == 0
+    keys = list(ledgermod.FLEET_GATE_KEYS)
+    rc = cli.main(
+        ["ledger", "--ledger", path, "gate", "--threshold", "0.05",
+         "--keys"] + keys
+    )
+    assert rc == 0
+    # the two records genuinely grouped (same config key), so the
+    # pass above was a real comparison, not a missing-baseline skip
+    recs = ledgermod.load(path)
+    assert recs[-1]["key"] == recs[0]["key"]
+    bad = dict(recs[-1], values=dict(recs[-1]["values"]))
+    bad["values"]["fleet_replicated_wire_bytes"] = int(
+        recs[-1]["values"]["fleet_replicated_wire_bytes"] * 1.5
+    )
+    bad["digest"] = ledgermod._digest(bad["values"])
+    ledgermod.append(path, [bad])
+    rc = cli.main(
+        ["ledger", "--ledger", path, "gate", "--threshold", "0.05",
+         "--keys"] + keys
+    )
+    assert rc == 1
+
+
 # ---- zero-compile warm submit THROUGH the dispatcher ----------------
 
 
@@ -381,7 +523,7 @@ def test_fleet_three_backend_load(
     """Load shape: 3 backends, a mixed batch of compaction +
     bookkeeper jobs through one dispatcher, every result solo-exact;
     the measured queue throughput / route latency / replication bytes
-    are emitted as a bench_schema-10 artifact the validator accepts
+    are emitted as a bench_schema-11 artifact the validator accepts
     and the ledger ingests."""
     configs = [
         _config(tmp_path / f"b{i}", slice_s=0.3) for i in range(3)
@@ -432,7 +574,7 @@ def test_fleet_three_backend_load(
         for d in daemons:
             d.shutdown()
 
-    # BENCH-shaped artifact at the fleet rev (bench_schema 10)
+    # BENCH-shaped artifact at the fleet rev (bench_schema 11)
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -459,8 +601,18 @@ def test_fleet_three_backend_load(
         fleet_replicated_wire_bytes=sum(
             snap["repl_bytes"].values()
         ),
+        # survivability latencies (r21): this healthy-path drill sees
+        # no drain/rejoin — null is the validator-legal value
+        fleet_failover_ms=(
+            1e3 * float(snap["failover_s"]) / snap["failover_n"]
+            if snap.get("failover_n") else None
+        ),
+        fleet_reconcile_ms=(
+            1e3 * float(snap["reconcile_s"]) / snap["reconcile_n"]
+            if snap.get("reconcile_n") else None
+        ),
     )
-    assert d["bench_schema"] == 10
+    assert d["bench_schema"] == 11
     errs = checker_mod.validate_bench_artifact(d, "fleet")
     assert errs == []
 
@@ -472,6 +624,6 @@ def test_fleet_three_backend_load(
     with open(art, "w") as f:
         f.write(json.dumps(d))
     rec = ledgermod.record_from_file(art)
-    assert rec["bench_schema"] == 10
+    assert rec["bench_schema"] == 11
     assert ledgermod.append(path, [rec]) == 1
     assert ledgermod.validate_ledger(path) == []

@@ -496,3 +496,83 @@ def test_fault_observer_breadcrumb(monkeypatch):
         faults.set_observer(None)
         faults.reset()
     assert seen == [("oom", "level", 3)]
+
+
+# ---- schema v14 + bench_schema 11 (fleet survivability, r21) ---------
+
+
+def test_validator_v14_survivability_events(tmp_path, checker_mod):
+    """The r21 events — ``reconcile`` (a lost job answered for by its
+    rejoined backend), ``partition`` (a drained backend rejoined
+    still holding its jobs), ``recover`` (a ``--recover`` table
+    rebuild) — validate with their required fields and fail without
+    them; v13-and-older records are NOT held to them (FIELD_SINCE)."""
+    good = str(tmp_path / "v14.jsonl")
+    with open(good, "w") as f:
+        for seq, (event, fields) in enumerate([
+            ("recover", {"jobs": 3}),
+            ("partition", {"backend": "b0.sock"}),
+            ("reconcile", {"backend": "b0.sock", "job_id": "j1",
+                           "state": "done"}),
+        ]):
+            f.write(json.dumps({
+                "v": 14, "event": event, "t": float(seq),
+                "seq": seq, "run_id": "surv", **fields,
+            }) + "\n")
+    assert checker_mod.validate_stream(good) == []
+
+    bad = str(tmp_path / "v14-bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(json.dumps({  # reconcile without the real state
+            "v": 14, "event": "reconcile", "t": 0.0, "seq": 0,
+            "run_id": "surv", "backend": "b0.sock",
+        }) + "\n")
+    errs = checker_mod.validate_stream(bad)
+    assert any("reconcile missing" in e for e in errs), errs
+
+    # committed v13 streams stay clean: the fields are since-14
+    old = str(tmp_path / "v13.jsonl")
+    with open(old, "w") as f:
+        f.write(json.dumps({
+            "v": 13, "event": "reconcile", "t": 0.0, "seq": 0,
+            "run_id": "surv",
+        }) + "\n")
+    assert checker_mod.validate_stream(old) == []
+
+
+def test_validator_v14_multi_incarnation_stream(tmp_path, checker_mod):
+    """A dispatcher restarted after kill -9 APPENDS to its stream:
+    distinct run_ids interleave legally (per-run monotonicity only),
+    but one run's writer repeating a seq is still a torn stream."""
+    p = str(tmp_path / "incarnations.jsonl")
+    with open(p, "w") as f:
+        for rid in ("life1", "life2", "life3"):
+            for seq in range(2):
+                f.write(json.dumps({
+                    "v": 14, "event": "route", "t": float(seq),
+                    "seq": seq, "run_id": rid, "backend": "b0",
+                    "tenant": "local",
+                }) + "\n")
+    assert checker_mod.validate_stream(p) == []
+    with open(p, "a") as f:
+        f.write(json.dumps({  # life2 repeats seq 1: torn
+            "v": 14, "event": "route", "t": 9.0, "seq": 1,
+            "run_id": "life2", "backend": "b0", "tenant": "local",
+        }) + "\n")
+    errs = checker_mod.validate_stream(p)
+    assert any("seq not increasing" in e for e in errs), errs
+
+
+def test_bench_schema11_requires_fleet_survivability_keys(checker_mod):
+    d = {k: None for k in checker_mod.BENCH_KEYS_V11}
+    d.update(bench_schema=11, value=1.0)
+    assert checker_mod.validate_bench_artifact(d) == []
+    for k in ("fleet_failover_ms", "fleet_reconcile_ms"):
+        broken = dict(d)
+        del broken[k]
+        errs = checker_mod.validate_bench_artifact(broken)
+        assert any(k in e for e in errs), (k, errs)
+    # schema-10 artifacts (committed r20 history) do NOT need them
+    d10 = {k: None for k in checker_mod.BENCH_KEYS_V10}
+    d10.update(bench_schema=10, value=1.0)
+    assert checker_mod.validate_bench_artifact(d10) == []
