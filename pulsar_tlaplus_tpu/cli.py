@@ -1087,7 +1087,9 @@ def _cmd_metrics(args) -> int:
         return 0
     cl = _service_client(args)
     try:
-        sys.stdout.write(cl.metrics())
+        sys.stdout.write(
+            cl.metrics(aggregate=bool(getattr(args, "aggregate", False)))
+        )
     except (ServiceError, OSError) as e:
         _client_fail("metrics", e)
     return 0
@@ -1105,6 +1107,14 @@ def _cmd_top(args) -> int:
 
         def frame():
             return top_mod.tail_stream_frame(args.stream, model)
+    elif getattr(args, "dispatch", False):
+        # fleet flight deck (r22): one dispatcher ping + one
+        # aggregate scrape per tick
+        cl = _service_client(args)
+        fleet_model = top_mod.FleetTopModel(_socket_of(args))
+
+        def frame():
+            return top_mod.poll_dispatch_frame(cl, fleet_model)
     else:
         cl = _service_client(args)
         model = top_mod.TopModel(_socket_of(args))
@@ -1805,12 +1815,17 @@ def main(argv=None):
         "trace",
         help="convert telemetry stream(s) into Perfetto-loadable "
         "Chrome trace JSON: BFS levels, ckpt stalls, sweep chunks, "
-        "daemon job slices + context-switch gaps on one timeline",
+        "daemon job slices + context-switch gaps on one timeline — "
+        "plus fleet dispatcher hops and trace_id flow arrows when a "
+        "dispatch.jsonl rides along (r22)",
     )
     ptr.add_argument(
         "stream", nargs="+",
         help="telemetry JSONL file(s): engine runs, a daemon's "
-        "service.jsonl, per-job jobs/<id>/events.jsonl — any mix",
+        "service.jsonl, per-job jobs/<id>/events.jsonl, a fleet "
+        "dispatcher's dispatch.jsonl — any mix; pass the dispatch "
+        "stream plus every backend's service.jsonl to stitch one "
+        "fleet timeline with cross-backend flow arrows",
     )
     ptr.add_argument(
         "-o", "--output", default="trace.json",
@@ -1827,6 +1842,12 @@ def main(argv=None):
         "--stream", default=None, metavar="FILE",
         help="derive metrics from this telemetry JSONL instead of "
         "scraping the daemon",
+    )
+    pm.add_argument(
+        "--aggregate", action="store_true",
+        help="against a fleet dispatcher: scrape every live backend "
+        "too and re-emit its families under a backend label beside "
+        "the fleet rollups + ptt_fleet_*_seconds histograms",
     )
     _add_client_args(pm)
 
@@ -1849,6 +1870,13 @@ def main(argv=None):
     pt.add_argument(
         "--once", action="store_true",
         help="render one frame (no ANSI clear) and exit",
+    )
+    pt.add_argument(
+        "--dispatch", action="store_true",
+        help="fleet flight deck: poll a dispatcher instead of a "
+        "daemon — per-backend health/score/stickiness table, fleet "
+        "job rollups, rate sparklines, histogram-derived p50/p99 "
+        "latency columns (one ping + one aggregate scrape per tick)",
     )
     _add_client_args(pt)
 

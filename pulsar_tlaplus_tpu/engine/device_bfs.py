@@ -2644,6 +2644,9 @@ class DeviceChecker:
             # present so per-tenant attribution never needs a join
             tenant=getattr(self, "tenant", None),
             warm=getattr(self, "warm", None),
+            # v15: distributed-trace identity (fleet dispatcher ->
+            # scheduler -> engine; None on standalone runs)
+            trace_id=getattr(self, "trace_id", None),
             # workload class (r18, schema v11): always "check" here —
             # the streaming walker swarm (sim/) is its own engine
             mode="check",

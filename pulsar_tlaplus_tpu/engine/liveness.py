@@ -1074,6 +1074,8 @@ class LivenessChecker:
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
             warm=getattr(self, "warm", None),
+            # v15: distributed-trace identity (None outside the daemon)
+            trace_id=getattr(self, "trace_id", None),
             # v11: workload class (two-phase liveness check)
             mode="liveness",
             wall_unix=round(time.time(), 3),

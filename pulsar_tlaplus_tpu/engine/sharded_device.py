@@ -1934,6 +1934,8 @@ class ShardedDeviceChecker:
             # v10: tenant identity (None outside the daemon)
             tenant=getattr(self, "tenant", None),
             warm=getattr(self, "warm", None),
+            # v15: distributed-trace identity (None outside the daemon)
+            trace_id=getattr(self, "trace_id", None),
             # v11: workload class (exhaustive BFS)
             mode="check",
             wall_unix=round(time.time(), 3),

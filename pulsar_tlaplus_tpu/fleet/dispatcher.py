@@ -18,7 +18,22 @@ Per request:
   ``watch`` relays the backend's stream line-for-line.
 - ``metrics`` renders the dispatcher's OWN ``ptt_fleet_*`` families
   (obs/metrics.py ``fleet_metrics``) from host-side counters — a
-  scrape never costs a backend round-trip.
+  scrape never costs a backend round-trip.  With ``aggregate`` set
+  (``cli.py metrics --aggregate``) every LIVE backend is scraped too
+  and its families re-emitted under a ``backend`` label beside fleet
+  rollups (obs/metrics.py ``aggregate_exposition``) — one poll, the
+  whole fleet.
+
+Observability (r22, docs/observability.md "Fleet plane"): every
+accepted submit is minted a ``trace_id`` that rides the wire to the
+chosen backend (echoed into its ``job_*`` events and the engine
+``run_header``) and stamps every dispatcher-side hop — route,
+replicate, failover, reconcile, hold/shed, watch-relay leg, terminal
+``complete`` — so ``cli.py trace --fleet`` can stitch one causal
+chain per job across machines.  Route/ack/failover/reconcile/relay/
+e2e latencies are observed into fixed-bucket histograms
+(obs/metrics.py ``LATENCY_BUCKETS_S``) rendered as Prometheus
+``ptt_fleet_*_seconds`` families.
 
 The health thread drives everything asynchronous: registry polls
 (drain after ``fail_after`` consecutive failures), failover (a
@@ -73,6 +88,7 @@ from typing import Dict, List, Optional, Tuple
 
 from pulsar_tlaplus_tpu.fleet import replicate as replmod
 from pulsar_tlaplus_tpu.fleet.registry import BackendRegistry
+from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
 from pulsar_tlaplus_tpu.obs import telemetry as obs
 from pulsar_tlaplus_tpu.service import auth as authmod
 from pulsar_tlaplus_tpu.service import jobs as jobmod
@@ -236,6 +252,11 @@ class FleetDispatcher:
         self._partitions: Dict[str, float] = {}
         self._recoveries = 0.0
         self._held_sheds = 0.0
+        self._holds = 0.0
+        # fixed-bucket latency histograms (r22): observed live at
+        # each hop, rendered by fleet_metrics, re-derivable from the
+        # telemetry stream (stream_metrics parity)
+        self._hists = metrics_mod.new_fleet_hists()
         # failover/reconcile latency accumulators (bench_schema 11)
         self._failover_s = 0.0
         self._failover_n = 0
@@ -344,6 +365,13 @@ class FleetDispatcher:
                 return
             if attempt == 1:
                 self.persist_failures += 1
+                # the event carries the CUMULATIVE counter (not a
+                # delta) so a stream replay reconstructs the same
+                # ptt_fleet_persist_failures_total value without
+                # double-counting (newest wins)
+                self.tel.emit(
+                    "persist_fail", n=self.persist_failures
+                )
                 self._log(
                     f"fleet: fleet_jobs.json persist FAILED "
                     f"({err!r:.120}); continuing — next transition "
@@ -382,11 +410,28 @@ class FleetDispatcher:
                 "recoveries": self._recoveries,
                 "persist_failures": float(self.persist_failures),
                 "held_sheds": self._held_sheds,
+                "holds": self._holds,
+                "hists": {
+                    k: h.copy() for k, h in self._hists.items()
+                },
                 "failover_s": self._failover_s,
                 "failover_n": self._failover_n,
                 "reconcile_s": self._reconcile_s,
                 "reconcile_n": self._reconcile_n,
             }
+
+    def _observe(self, family: str, ms: Optional[float]) -> None:
+        """Fold one latency sample (milliseconds) into the live
+        ``ptt_fleet_*_seconds`` histogram for ``family``.  The sample
+        is rounded exactly like the emitted ``*_ms`` field so stream
+        replay re-bins IDENTICALLY to the live scrape — an unrounded
+        live sample could land one bucket off at a boundary."""
+        if ms is None:
+            return
+        with self._ctr_lock:
+            hist = self._hists.get(family)
+            if hist is not None:
+                hist.observe(round(ms, 3) / 1000.0)
 
     # ---------------------------------------------------- recovery
 
@@ -665,6 +710,8 @@ class FleetDispatcher:
         dedup path; mark its running/suspended jobs ``lost`` (their
         client resubmits through the dispatcher and warm-starts
         wherever replication reached)."""
+        t_fo = time.monotonic()
+        trace_ids: List[str] = []
         with self._jobs_lock:
             owned = [
                 (jid, dict(rec))
@@ -677,6 +724,8 @@ class FleetDispatcher:
             ]
         resubmitted = 0
         for jid, rec in owned:
+            if rec.get("trace_id"):
+                trace_ids.append(rec["trace_id"])
             if rec.get("state") != jobmod.QUEUED:
                 self._update_job(jid, state=LOST)
                 continue
@@ -744,8 +793,17 @@ class FleetDispatcher:
             self._resub[backend.addr] = (
                 self._resub.get(backend.addr, 0) + resubmitted
             )
+        fo_ms = (time.monotonic() - t_fo) * 1000.0
+        self._observe("ptt_fleet_failover_seconds", fo_ms)
         self.tel.emit(
-            "failover", backend=backend.addr, resubmitted=resubmitted
+            "failover",
+            backend=backend.addr,
+            resubmitted=resubmitted,
+            # every affected job's chain (resubmitted AND lost): the
+            # trace stitcher joins the old backend's slices to the
+            # new backend's through this one record
+            trace_ids=trace_ids,
+            wall_ms=round(fo_ms, 3),
         )
         self._log(
             f"fleet: failover from {backend.addr} "
@@ -761,6 +819,7 @@ class FleetDispatcher:
         marker), still-running ones resume status/result/watch relay.
         Exactly-once is the existing ``submit_id`` dedup: the job
         only ever ran on this backend."""
+        t_rc = time.monotonic()
         with self._jobs_lock:
             lost_jobs = [
                 (jid, dict(rec))
@@ -807,9 +866,14 @@ class FleetDispatcher:
                 backend=backend.addr,
                 job_id=jid,
                 state=state,
+                trace_id=rec.get("trace_id"),
             )
-            if terminal and self.config.replicate:
-                self._replicate_from(backend.addr)
+            if terminal:
+                self._emit_complete(jid, backend.addr, rec, state)
+                if self.config.replicate:
+                    self._replicate_from(
+                        backend.addr, trace_id=rec.get("trace_id")
+                    )
         if lost_jobs:
             # it held jobs through the outage: that was a partition
             # window closing, not a restart
@@ -817,11 +881,14 @@ class FleetDispatcher:
                 self._partitions[backend.addr] = (
                     self._partitions.get(backend.addr, 0) + 1
                 )
+            rc_ms = (time.monotonic() - t_rc) * 1000.0
+            self._observe("ptt_fleet_reconcile_seconds", rc_ms)
             self.tel.emit(
                 "partition",
                 backend=backend.addr,
                 lost_jobs=len(lost_jobs),
                 reconciled=reconciled,
+                wall_ms=round(rc_ms, 3),
             )
             self._log(
                 f"fleet: backend {backend.addr} rejoined holding "
@@ -835,14 +902,19 @@ class FleetDispatcher:
         its warm artifact lands on every peer."""
         with self._jobs_lock:
             open_jobs = [
-                (jid, rec.get("backend"), rec.get("backend_job_id"))
+                (
+                    jid,
+                    rec.get("backend"),
+                    rec.get("backend_job_id"),
+                    dict(rec),
+                )
                 for jid, rec in self._jobs.items()
                 if not rec.get("done_handled")
                 and rec.get("state") != LOST
                 and not rec.get("alias_of")
             ]
         up = {b.addr for b in self.registry.healthy()}
-        for jid, addr, backend_jid in open_jobs:
+        for jid, addr, backend_jid, rec in open_jobs:
             if addr not in up:
                 continue
             auth = self.fleet_token if protocol.is_tcp(addr) else None
@@ -867,10 +939,41 @@ class FleetDispatcher:
                 jid, state=state,
                 **({"done_handled": True} if terminal else {}),
             )
-            if terminal and self.config.replicate:
-                self._replicate_from(addr)
+            if terminal:
+                self._emit_complete(jid, addr, rec, state)
+                if self.config.replicate:
+                    self._replicate_from(
+                        addr, trace_id=rec.get("trace_id")
+                    )
 
-    def _replicate_from(self, src_addr: str) -> None:
+    def _emit_complete(
+        self, jid: str, addr: str, rec: dict, state: str
+    ) -> None:
+        """One ``complete`` event per job at its terminal flip: the
+        end-to-end latency (submit accept -> terminal observed) is
+        wall-clock from the persisted ``accepted_unix`` stamp, so it
+        survives a dispatcher restart mid-job.  A job adopted by
+        ``--recover`` has no accept stamp and reports ``e2e_ms``
+        null (present — the v15 envelope requires the key)."""
+        e2e_ms = None
+        accepted = rec.get("accepted_unix")
+        if isinstance(accepted, (int, float)):
+            e2e_ms = round(
+                max(0.0, time.time() - accepted) * 1000.0, 3
+            )
+        self._observe("ptt_fleet_job_e2e_seconds", e2e_ms)
+        self.tel.emit(
+            "complete",
+            job_id=jid,
+            backend=addr,
+            state=state,
+            e2e_ms=e2e_ms,
+            trace_id=rec.get("trace_id"),
+        )
+
+    def _replicate_from(
+        self, src_addr: str, trace_id: Optional[str] = None
+    ) -> None:
         """One sieve pass: every artifact on ``src_addr`` offered to
         every healthy peer (fleet/replicate.py).  Repeats are cheap —
         a current peer answers ``identical`` and no data moves."""
@@ -880,8 +983,12 @@ class FleetDispatcher:
         ]
         if not peers:
             return
+        t_prev = [time.monotonic()]
 
         def on_pass(r: dict) -> None:
+            now = time.monotonic()
+            wall_ms = (now - t_prev[0]) * 1000.0
+            t_prev[0] = now
             if r.get("status") not in ("ok",):
                 return
             dst = r.get("dst") or "?"
@@ -899,6 +1006,9 @@ class FleetDispatcher:
                 blobs=int(r.get("blobs") or 0),
                 wire_bytes=int(r.get("wire_bytes") or 0),
                 config_sig=r.get("config_sig"),
+                # the terminal job whose artifact this pass carries
+                trace_id=trace_id,
+                wall_ms=round(wall_ms, 3),
             )
 
         try:
@@ -1037,7 +1147,11 @@ class FleetDispatcher:
                 "uptime_s": round(time.time() - self._t0, 1),
                 "fleet": True,
                 "backends": self.registry.snapshot(),
+                # full routing view for the flight deck (r22):
+                # score/load/stickiness per backend from one ping
+                "backends_detail": self.registry.detail_snapshot(),
                 "jobs": counts,
+                "held": self._held,
                 "persist_failures": self.persist_failures,
                 "warmed": [],
             },
@@ -1051,15 +1165,26 @@ class FleetDispatcher:
         # the backend's dedup can only answer the same job if the
         # retry lands on the same daemon
         sticky_owner = None
+        trace_id = None
         with self._jobs_lock:
             for rec in self._jobs.values():
                 if rec.get("submit_id") == submit_id and not rec.get(
                     "alias_of"
                 ):
                     sticky_owner = rec.get("backend")
+                    # a dedup-keyed retry is the SAME logical submit:
+                    # it keeps the chain it already started
+                    trace_id = rec.get("trace_id")
                     break
+        if not trace_id:
+            trace_id = uuid.uuid4().hex
         fwd = {k: req[k] for k in _SUBMIT_FIELDS if k in req}
         fwd["submit_id"] = submit_id
+        # forwarded on the wire so the backend echoes it into its
+        # job_* events and the engine run_header — and persisted in
+        # the job record's submit dict so a failover resubmit
+        # re-forwards the SAME id (one chain across backends)
+        fwd["trace_id"] = trace_id
         tried: set = set()
         last_err = "no healthy backend"
 
@@ -1095,7 +1220,9 @@ class FleetDispatcher:
             # queue-and-hold instead of bouncing instantly — a fleet
             # mid-failover usually recovers within one health
             # interval, and the hold absorbs it invisibly
-            candidates = self._hold_for_fleet(_candidates)
+            candidates = self._hold_for_fleet(
+                _candidates, tenant, trace_id
+            )
             if candidates is None:
                 protocol.send_json(
                     w,
@@ -1126,6 +1253,11 @@ class FleetDispatcher:
             )
             if not protocol.is_tcp(backend.addr):
                 auth = None
+            # route_ms = the routing DECISION (arrival -> backend
+            # picked, hold window included); ack_ms = the full path
+            # (arrival -> backend's ack in hand) — the two histogram
+            # families the flight deck splits dispatch overhead by
+            t_fwd = time.monotonic()
             try:
                 resp = protocol.request(
                     backend.addr, "submit",
@@ -1141,7 +1273,8 @@ class FleetDispatcher:
                 # must see the backend's own code
                 protocol.send_json(w, resp)
                 return
-            route_ms = (time.monotonic() - t0) * 1000.0
+            route_ms = (t_fwd - t0) * 1000.0
+            ack_ms = (time.monotonic() - t0) * 1000.0
             jid = resp["job_id"]
             self._record_job(
                 jid,
@@ -1152,22 +1285,35 @@ class FleetDispatcher:
                     "submit_id": submit_id,
                     "submit": fwd,
                     "done_handled": False,
+                    "trace_id": trace_id,
+                    # wall-clock accept stamp: e2e_ms on the terminal
+                    # `complete` event survives a dispatcher restart
+                    "accepted_unix": round(time.time(), 3),
                 },
             )
             with self._ctr_lock:
                 key = (backend.addr, why)
                 self._routes[key] = self._routes.get(key, 0) + 1
                 self._route_s += route_ms / 1000.0
+            self._observe("ptt_fleet_route_seconds", route_ms)
+            self._observe("ptt_fleet_submit_ack_seconds", ack_ms)
             self.tel.emit(
                 "route",
                 backend=backend.addr,
                 tenant=tenant,
                 reason=why,
                 route_ms=round(route_ms, 3),
+                ack_ms=round(ack_ms, 3),
                 job_id=jid,
+                trace_id=trace_id,
             )
             protocol.send_json(
-                w, {**resp, "backend": backend.addr}
+                w,
+                {
+                    **resp,
+                    "backend": backend.addr,
+                    "trace_id": trace_id,
+                },
             )
             return
         protocol.send_json(
@@ -1179,7 +1325,9 @@ class FleetDispatcher:
             ),
         )
 
-    def _hold_for_fleet(self, rebuild) -> Optional[List]:
+    def _hold_for_fleet(
+        self, rebuild, tenant: str, trace_id: str
+    ) -> Optional[List]:
         """Bounded queue-and-hold for an all-backends-down window:
         the submit waits up to ``hold_s`` for any backend to come
         back, with at most ``hold_max`` submits held at once.
@@ -1192,8 +1340,20 @@ class FleetDispatcher:
             if self._held >= self.config.hold_max:
                 with self._ctr_lock:
                     self._held_sheds += 1
+                self.tel.emit(
+                    "shed",
+                    tenant=tenant,
+                    held=self._held,
+                    trace_id=trace_id,
+                )
                 return None
             self._held += 1
+            held_now = self._held
+        with self._ctr_lock:
+            self._holds += 1
+        self.tel.emit(
+            "hold", tenant=tenant, held=held_now, trace_id=trace_id
+        )
         try:
             deadline = time.monotonic() + self.config.hold_s
             while (
@@ -1345,6 +1505,7 @@ class FleetDispatcher:
                 _WATCH_RELAY_LEG_S,
                 max(0.1, deadline - time.monotonic()),
             )
+            leg_t0 = time.monotonic()
             try:
                 # raw relay (not protocol.stream, which EATS the
                 # ack): the backend's acknowledgment, every event,
@@ -1419,18 +1580,58 @@ class FleetDispatcher:
                 time.sleep(
                     min(0.3, self.config.health_interval_s)
                 )
+            finally:
+                # one relay event per leg — broken legs included
+                # (the flight deck's watch-leg histogram must see
+                # failover gaps, not just the happy path)
+                leg_ms = (time.monotonic() - leg_t0) * 1000.0
+                self._observe("ptt_fleet_watch_leg_seconds", leg_ms)
+                self.tel.emit(
+                    "relay",
+                    job_id=req["job_id"],
+                    leg_ms=round(leg_ms, 3),
+                    trace_id=rec.get("trace_id"),
+                )
             with self._jobs_lock:
                 rec = self._jobs.get(req["job_id"]) or {}
 
     def _op_metrics(self, req, w) -> None:
-        from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
-
-        text = metrics_mod.render_exposition(
+        own = metrics_mod.render_exposition(
             metrics_mod.fleet_metrics(
                 self, uptime_s=time.time() - self._t0
             )
         )
-        protocol.send_json(w, {"ok": True, "metrics": text})
+        if not req.get("aggregate"):
+            protocol.send_json(w, {"ok": True, "metrics": own})
+            return
+        # fleet-wide scrape (r22): every LIVE backend polled once,
+        # its families re-emitted under a `backend` label; a down or
+        # mid-scrape-failing backend becomes a ptt_fleet_scrape_
+        # errors sample instead of failing the whole exposition
+        up = {b.addr for b in self.registry.healthy()}
+        scraped: Dict[str, Optional[str]] = {}
+        for addr in self.config.backends:
+            if addr not in up:
+                scraped[addr] = None
+                continue
+            auth = (
+                self.fleet_token if protocol.is_tcp(addr) else None
+            )
+            try:
+                resp = protocol.request(
+                    addr, "metrics",
+                    timeout=self.config.backend_timeout_s,
+                    **({"auth": auth} if auth else {}),
+                )
+                scraped[addr] = (
+                    resp.get("metrics") if resp.get("ok") else None
+                )
+            except (OSError, protocol.ProtocolError):
+                scraped[addr] = None
+        text = metrics_mod.aggregate_exposition(own, scraped)
+        protocol.send_json(
+            w, {"ok": True, "metrics": text, "aggregate": True}
+        )
 
     def _op_shutdown(self, req, w) -> None:
         if req.get("_tenant") != authmod.LOCAL_TENANT:

@@ -278,6 +278,37 @@ class BackendRegistry:
         with self._lock:
             return {a: b.state for a, b in self.backends.items()}
 
+    def detail_snapshot(self) -> Dict[str, dict]:
+        """addr -> full routing view, for the fleet flight deck
+        (``cli.py top --dispatch``, r22): everything :meth:`choose`
+        weighs — score, load signal, shed pressure, warm artifacts,
+        failure streaks — plus how many tenants are currently sticky
+        to each backend, so the deck shows WHY routing goes where it
+        goes, not just where."""
+        now = time.time()
+        with self._lock:
+            sticky_n: Dict[str, int] = {}
+            for addr, placed in self._sticky.values():
+                if now - placed <= self.sticky_s:
+                    sticky_n[addr] = sticky_n.get(addr, 0) + 1
+            return {
+                a: {
+                    "state": b.state,
+                    "score": round(b.score(), 3),
+                    "queue_depth": b.queue_depth,
+                    "running": b.running,
+                    "inflight": b.inflight,
+                    "sheds": b.sheds,
+                    "warmed": b.warmed,
+                    "failures": b.failures,
+                    "ok_streak": b.ok_streak,
+                    "pid": b.pid,
+                    "last_ok_unix": b.last_ok_unix,
+                    "sticky_tenants": sticky_n.get(a, 0),
+                }
+                for a, b in self.backends.items()
+            }
+
     # ------------------------------------------- sticky persistence
 
     def sticky_snapshot(self) -> Dict[str, List]:

@@ -24,17 +24,107 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+# ---------------------------------------------------------- histograms
+
+# The ONE fixed bucket ladder every ptt_*_seconds latency histogram
+# uses (r22).  Fixed — never adaptive — so a live dispatcher scrape
+# and a stream replay re-bin the identical observations into the
+# identical cumulative counts, and so two backends' histograms are
+# always mergeable bucket-for-bucket.  Spans sub-ms routing decisions
+# to multi-minute end-to-end jobs.
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+def _fmt_le(b: float) -> str:
+    return f"{b:g}"
+
+
+class Histogram:
+    """A fixed-bucket latency histogram (Prometheus semantics: the
+    rendered ``_bucket`` series are CUMULATIVE and end at
+    ``le="+Inf"``; ``_sum``/``_count`` ride beside them).  ``counts``
+    holds per-bucket (non-cumulative) tallies, one extra slot for
+    +Inf — cumulation happens at render time."""
+
+    def __init__(self, bounds: Tuple[float, ...] = LATENCY_BUCKETS_S):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        i = len(self.bounds)
+        for j, b in enumerate(self.bounds):
+            if s <= b:
+                i = j
+                break
+        self.counts[i] += 1
+        self.sum += s
+        self.count += 1
+
+    def copy(self) -> "Histogram":
+        h = Histogram(self.bounds)
+        h.counts = list(self.counts)
+        h.sum = self.sum
+        h.count = self.count
+        return h
+
+    def cumulative(self) -> List[Tuple[str, int]]:
+        """[(le_label, cumulative_count)] ending at ("+Inf", count)."""
+        out: List[Tuple[str, int]] = []
+        acc = 0
+        for b, n in zip(self.bounds, self.counts):
+            acc += n
+            out.append((_fmt_le(b), acc))
+        out.append(("+Inf", self.count))
+        return out
+
+
+def histogram_quantile(
+    q: float, cumulative: List[Tuple[float, float]]
+) -> Optional[float]:
+    """Prometheus-style quantile estimate from cumulative
+    ``[(le, count)]`` pairs (le may be ``float("inf")``): linear
+    interpolation within the bucket the rank falls in, the upper
+    bound for the +Inf bucket's lower edge.  None on an empty
+    histogram — absent beats a fabricated zero."""
+    pairs = sorted(cumulative, key=lambda p: p[0])
+    if not pairs or pairs[-1][1] <= 0:
+        return None
+    total = pairs[-1][1]
+    rank = q * total
+    prev_le, prev_n = 0.0, 0.0
+    for le, n in pairs:
+        if n >= rank:
+            if le == float("inf"):
+                return prev_le  # unbounded bucket: report its floor
+            if n == prev_n:
+                return le
+            frac = (rank - prev_n) / (n - prev_n)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_n = le, n
+    return pairs[-1][0]
+
+
 # ------------------------------------------------------------ families
 
 
 class Family:
-    """One metric family: name, type, help, and labelled samples."""
+    """One metric family: name, type, help, and labelled samples.
+    ``kind`` may be ``histogram`` (r22): such a family holds
+    :class:`Histogram` samples added via :meth:`add_hist` and renders
+    the Prometheus ``_bucket``/``_sum``/``_count`` triplet."""
 
     def __init__(self, name: str, kind: str, help_: str):
         self.name = name
-        self.kind = kind  # "gauge" | "counter"
+        self.kind = kind  # "gauge" | "counter" | "histogram"
         self.help = help_
         self.samples: List[Tuple[Dict[str, str], float]] = []
+        self.hist_samples: List[Tuple[Dict[str, str], Histogram]] = []
 
     def add(self, value, labels: Optional[Dict[str, str]] = None):
         if value is None:
@@ -42,23 +132,49 @@ class Family:
         self.samples.append((dict(labels or {}), float(value)))
         return self
 
+    def add_hist(
+        self, hist: Optional[Histogram],
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        if hist is None or hist.count <= 0:
+            return self
+        self.hist_samples.append((dict(labels or {}), hist))
+        return self
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
 
 def render_exposition(families: List[Family]) -> str:
     """Families -> Prometheus text exposition (families with no
     samples are skipped — absent beats a fabricated zero)."""
     lines: List[str] = []
     for f in families:
+        if f.kind == "histogram":
+            if not f.hist_samples:
+                continue
+            lines.append(f"# HELP {f.name} {f.help}")
+            lines.append(f"# TYPE {f.name} histogram")
+            for labels, h in f.hist_samples:
+                for le, n in h.cumulative():
+                    lab = _fmt_labels({**labels, "le": le})
+                    lines.append(f"{f.name}_bucket{lab} {n}")
+                lab = _fmt_labels(labels)
+                lines.append(f"{f.name}_sum{lab} {round(h.sum, 6)}")
+                lines.append(f"{f.name}_count{lab} {h.count}")
+            continue
         if not f.samples:
             continue
         lines.append(f"# HELP {f.name} {f.help}")
         lines.append(f"# TYPE {f.name} {f.kind}")
         for labels, value in f.samples:
-            lab = ""
-            if labels:
-                inner = ",".join(
-                    f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
-                )
-                lab = "{" + inner + "}"
+            lab = _fmt_labels(labels)
             if value == int(value):
                 lines.append(f"{f.name}{lab} {int(value)}")
             else:
@@ -106,6 +222,107 @@ def parse_exposition(text: str):
                     )
         out.setdefault(name, []).append((labels, value))
     return out, types
+
+
+def validate_exposition(text: str, label: str = "<exposition>"):
+    """Structural violations in a Prometheus text exposition (empty
+    list = clean) — the histogram-consistency cross-check behind
+    ``check_telemetry_schema.py --metrics``.
+
+    For every TYPE-histogram family, each label-set's bucket series
+    must: carry parseable ``le`` labels ending at ``+Inf``; be
+    cumulative (monotone non-decreasing by ascending ``le``); agree
+    with its ``_count`` sample (+Inf bucket == count); and carry a
+    ``_sum`` bounded by what the buckets admit — at least
+    sum(bucket_count * lower_edge), and (when no observation landed
+    past the last finite bucket) at most sum(bucket_count * le).  A
+    scrape that re-bins, drops a bucket, or double-counts fails here
+    rather than silently skewing every derived quantile."""
+    errors: List[str] = []
+    try:
+        samples, types = parse_exposition(text)
+    except ValueError as e:
+        return [f"{label}: {e}"]
+    for fam, kind in sorted(types.items()):
+        if kind != "histogram":
+            continue
+        # group bucket samples by their non-le label set
+        series: Dict[tuple, List[Tuple[float, float]]] = {}
+        for labels, v in samples.get(fam + "_bucket", []):
+            rest = tuple(
+                sorted((k, x) for k, x in labels.items() if k != "le")
+            )
+            le_s = labels.get("le")
+            try:
+                le = float(le_s)
+            except (TypeError, ValueError):
+                errors.append(
+                    f"{label}: {fam}_bucket has unparseable "
+                    f"le={le_s!r}"
+                )
+                continue
+            series.setdefault(rest, []).append((le, v))
+        counts = {
+            tuple(sorted(lb.items())): v
+            for lb, v in samples.get(fam + "_count", [])
+        }
+        sums = {
+            tuple(sorted(lb.items())): v
+            for lb, v in samples.get(fam + "_sum", [])
+        }
+        if not series:
+            errors.append(f"{label}: histogram {fam} has no buckets")
+        for rest, pairs in sorted(series.items()):
+            where = f"{label}: {fam}{dict(rest) or ''}"
+            pairs.sort(key=lambda p: p[0])
+            if pairs[-1][0] != float("inf"):
+                errors.append(f"{where}: no +Inf bucket")
+            prev = 0.0
+            for le, v in pairs:
+                if v < prev:
+                    errors.append(
+                        f"{where}: bucket le={le:g} count {v:g} < "
+                        f"previous {prev:g} (buckets are cumulative)"
+                    )
+                prev = v
+            total = counts.get(rest)
+            if total is None:
+                errors.append(f"{where}: missing _count sample")
+            elif pairs[-1][0] == float("inf") and total != pairs[-1][1]:
+                errors.append(
+                    f"{where}: _count {total:g} != +Inf bucket "
+                    f"{pairs[-1][1]:g}"
+                )
+            s = sums.get(rest)
+            if s is None:
+                errors.append(f"{where}: missing _sum sample")
+                continue
+            if total is not None and total == 0 and s != 0:
+                errors.append(
+                    f"{where}: _sum {s:g} with zero _count"
+                )
+            # bounds the buckets admit (1e-6 slack: _sum is rounded)
+            lo = hi = 0.0
+            prev_cum = 0.0
+            prev_le = 0.0
+            unbounded = False
+            for le, v in pairs:
+                n_in = v - prev_cum
+                lo += n_in * prev_le
+                if le == float("inf"):
+                    unbounded = unbounded or n_in > 0
+                else:
+                    hi += n_in * le
+                prev_cum, prev_le = v, le
+            if s < lo - 1e-6:
+                errors.append(
+                    f"{where}: _sum {s:g} below bucket floor {lo:g}"
+                )
+            if not unbounded and s > hi + 1e-6:
+                errors.append(
+                    f"{where}: _sum {s:g} above bucket ceiling {hi:g}"
+                )
+    return errors
 
 
 # ----------------------------------------------- shared engine families
@@ -328,6 +545,73 @@ def _warm_families(
     return out
 
 
+# the six fleet latency histograms (r22): metric family name ->
+# (help, the dispatcher-stream event + millisecond field each
+# observation rides, so stream replay re-bins identically to the
+# live scrape — the r12 live-vs-stream contract)
+FLEET_HIST_SPECS: Tuple[Tuple[str, str, str, str], ...] = (
+    ("ptt_fleet_route_seconds",
+     "Routing decision latency (submit arrival to backend pick)",
+     "route", "route_ms"),
+    ("ptt_fleet_submit_ack_seconds",
+     "Submit latency end-to-end (arrival to backend ack relayed)",
+     "route", "ack_ms"),
+    ("ptt_fleet_job_e2e_seconds",
+     "End-to-end job latency (submit accepted to observed terminal)",
+     "complete", "e2e_ms"),
+    ("ptt_fleet_watch_leg_seconds",
+     "Watch-relay leg duration (owner re-resolution cadence)",
+     "relay", "leg_ms"),
+    ("ptt_fleet_failover_seconds",
+     "Failover pass duration (drain detected to jobs resubmitted)",
+     "failover", "wall_ms"),
+    ("ptt_fleet_reconcile_seconds",
+     "Reconcile pass duration (rejoin detected to lost jobs "
+     "answered for)",
+     "partition", "wall_ms"),
+)
+
+
+def new_fleet_hists() -> Dict[str, Histogram]:
+    """One fixed-bucket histogram per fleet latency family — the
+    shared shape for the dispatcher's live state and the stream
+    replay."""
+    return {name: Histogram() for name, _h, _e, _f in FLEET_HIST_SPECS}
+
+
+def _fleet_hist_families(
+    hists: Optional[Dict[str, Histogram]],
+) -> List[Family]:
+    out: List[Family] = []
+    for name, help_, _ev, _field in FLEET_HIST_SPECS:
+        out.append(
+            Family(name, "histogram", help_).add_hist(
+                (hists or {}).get(name)
+            )
+        )
+    return out
+
+
+def fleet_hists_from_events(events: List[dict]) -> Dict[str, Histogram]:
+    """Re-bin a dispatcher stream's latency observations into the
+    same fixed buckets the live dispatcher maintains — family-for-
+    family (and bucket-for-bucket) identical to a live scrape over
+    the same history."""
+    hists = new_fleet_hists()
+    by_event: Dict[Tuple[str, str], str] = {
+        (ev, field): name
+        for name, _h, ev, field in FLEET_HIST_SPECS
+    }
+    for e in events:
+        ev = e.get("event")
+        for (src_ev, field), name in by_event.items():
+            if ev == src_ev and isinstance(
+                e.get(field), (int, float)
+            ):
+                hists[name].observe(float(e[field]) / 1000.0)
+    return hists
+
+
 def _fleet_families(
     backends: Dict[str, str],
     routes: Dict[Tuple[str, str], float],
@@ -340,6 +624,9 @@ def _fleet_families(
     partitions: Optional[Dict[str, float]] = None,
     recoveries: float = 0.0,
     persist_failures: float = 0.0,
+    holds: float = 0.0,
+    held_sheds: float = 0.0,
+    hists: Optional[Dict[str, Histogram]] = None,
 ) -> List[Family]:
     """The r20 fleet-dispatcher families (docs/fleet.md): backend
     health by address, submit placements by backend and routing
@@ -417,10 +704,24 @@ def _fleet_families(
         "fleet_jobs.json persists that failed BOTH attempts "
         "(the dispatcher kept serving memory-only)",
     ).add(persist_failures or None)
+    # r22: the all-backends-down queue-and-hold, previously counted
+    # host-side only (the held_sheds snapshot key never reached a
+    # family) — now a first-class pair so a hold storm is visible in
+    # both the live scrape and the stream replay
+    f_holds = Family(
+        "ptt_fleet_holds_total", "counter",
+        "Submits held through an all-backends-down window",
+    ).add(holds or None)
+    f_sheds = Family(
+        "ptt_fleet_held_sheds_total", "counter",
+        "Submits shed because the hold buffer was full (typed "
+        "capacity rejection)",
+    ).add(held_sheds or None)
     return [
         f_back, f_routes, f_route_s, f_blobs, f_bytes, f_fail,
-        f_resub, f_recon, f_part, f_recov, f_persist,
-    ]
+        f_resub, f_recon, f_part, f_recov, f_persist, f_holds,
+        f_sheds,
+    ] + _fleet_hist_families(hists)
 
 
 def fleet_metrics(dispatcher, uptime_s: Optional[float] = None) -> List[Family]:
@@ -445,6 +746,9 @@ def fleet_metrics(dispatcher, uptime_s: Optional[float] = None) -> List[Family]:
         partitions=snap.get("partitions"),
         recoveries=snap.get("recoveries", 0.0),
         persist_failures=snap.get("persist_failures", 0.0),
+        holds=snap.get("holds", 0.0),
+        held_sheds=snap.get("held_sheds", 0.0),
+        hists=snap.get("hists"),
     )
 
 
@@ -614,6 +918,14 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     fleet_recon: Dict[str, float] = {}
     fleet_part: Dict[str, float] = {}
     fleet_recoveries = 0.0
+    # fleet observability stream (r22): the queue-and-hold pair, the
+    # persist-failure counter (newest cumulative value wins — the
+    # event carries the counter so replay can't double-count), and
+    # whether any r22 event/field appeared (gates the histograms)
+    fleet_holds = 0.0
+    fleet_sheds = 0.0
+    fleet_persist = 0.0
+    fleet_seen = False
     for e in events:
         ev = e.get("event")
         if ev == "route":
@@ -650,6 +962,19 @@ def stream_metrics(events: List[dict]) -> List[Family]:
             fleet_backends[addr] = "up"  # rejoined when this fired
         elif ev == "recover":
             fleet_recoveries += 1
+        elif ev == "hold":
+            fleet_holds += 1
+            fleet_seen = True
+        elif ev == "shed":
+            fleet_sheds += 1
+            fleet_seen = True
+        elif ev == "persist_fail":
+            # the event carries the CUMULATIVE counter: newest wins
+            if isinstance(e.get("n"), (int, float)):
+                fleet_persist = max(fleet_persist, float(e["n"]))
+            fleet_seen = True
+        elif ev in ("complete", "relay"):
+            fleet_seen = True
         if ev == "warm":
             # mirror the live daemon's counting points exactly: a cold
             # PLAN is final (the job never reaches install), a
@@ -761,6 +1086,7 @@ def stream_metrics(events: List[dict]) -> List[Family]:
     if (
         fleet_backends or fleet_routes or fleet_blobs
         or fleet_failovers or fleet_recon or fleet_recoveries
+        or fleet_seen
     ):
         fams += _fleet_families(
             fleet_backends, fleet_routes, fleet_route_s,
@@ -768,6 +1094,10 @@ def stream_metrics(events: List[dict]) -> List[Family]:
             reconciled=fleet_recon,
             partitions=fleet_part,
             recoveries=fleet_recoveries,
+            persist_failures=fleet_persist,
+            holds=fleet_holds,
+            held_sheds=fleet_sheds,
+            hists=fleet_hists_from_events(events),
         )
 
     # daemon streams additionally carry the job lifecycle
@@ -828,3 +1158,152 @@ def stream_metrics(events: List[dict]) -> List[Family]:
 
 def render_stream_metrics(events: List[dict]) -> str:
     return render_exposition(stream_metrics(events))
+
+
+# ---------------------------------------------------- aggregate scrape
+
+
+def _family_of(sample_name: str, types: Dict[str, str]) -> str:
+    """The family a sample line belongs to: histogram sub-samples
+    (``x_bucket``/``x_sum``/``x_count``) fold back into ``x``."""
+    for suf in ("_bucket", "_sum", "_count"):
+        base = sample_name[: -len(suf)]
+        if sample_name.endswith(suf) and types.get(base) == "histogram":
+            return base
+    return sample_name
+
+
+def _ingest_exposition(
+    text: str,
+    backend: Optional[str],
+    blocks: Dict[str, dict],
+    order: List[str],
+) -> None:
+    """Fold one exposition text into the merged family blocks,
+    stamping every sample with the ``backend`` label (None = the
+    dispatcher's own families, re-emitted verbatim).  Merging by
+    family keeps the output well-formed: one ``# TYPE`` block per
+    family even when N backends export the same name."""
+    helps: Dict[str, str] = {}
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], str]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _h, _k, name, help_ = line.split(None, 3)
+            helps[name] = help_
+            continue
+        if line.startswith("# TYPE "):
+            _h, _k, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        key, val_s = line.rsplit(None, 1)
+        name, labels = key, {}
+        if "{" in key:
+            name, rest = key.split("{", 1)
+            body = rest[:-1] if rest.endswith("}") else rest
+            for part in body.split(","):
+                if not part:
+                    continue
+                k, v = part.split("=", 1)
+                labels[k] = v.strip('"')
+        samples.append((name, labels, val_s))
+    for name, labels, val_s in samples:
+        fam = _family_of(name, types)
+        b = blocks.get(fam)
+        if b is None:
+            b = {
+                "kind": types.get(fam),
+                "help": helps.get(fam),
+                "lines": [],
+            }
+            blocks[fam] = b
+            order.append(fam)
+        if backend is not None:
+            labels = {**labels, "backend": backend}
+        b["lines"].append((name, labels, val_s))
+
+
+def aggregate_exposition(
+    own_text: str, scraped: Dict[str, Optional[str]]
+) -> str:
+    """The dispatcher's ``metrics --aggregate`` answer (r22): its OWN
+    families verbatim, every live backend's families re-emitted with
+    a ``backend`` label, and fleet rollups (summed job-table /
+    queue-depth gauges) — one scrape, the whole fleet.  A backend
+    down mid-scrape is skipped and reported in
+    ``ptt_fleet_scrape_errors`` instead of failing the scrape."""
+    blocks: Dict[str, dict] = {}
+    order: List[str] = []
+    _ingest_exposition(own_text, None, blocks, order)
+
+    roll_jobs: Dict[str, float] = {}
+    roll_queue = 0.0
+    roll_active = 0.0
+    saw_jobs = False
+    errors: List[str] = []
+    for addr in sorted(scraped):
+        text = scraped[addr]
+        if text is None:
+            errors.append(addr)
+            continue
+        out, _types = parse_exposition(text)
+        for labels, v in out.get("ptt_jobs", []):
+            st = labels.get("state", "?")
+            roll_jobs[st] = roll_jobs.get(st, 0.0) + v
+            saw_jobs = True
+        for _labels, v in out.get("ptt_queue_depth", []):
+            roll_queue += v
+        for _labels, v in out.get("ptt_active_job", []):
+            roll_active += v
+
+    roll_fams: List[Family] = []
+    if saw_jobs:
+        f_jobs = Family(
+            "ptt_fleet_jobs", "gauge",
+            "Backend job tables summed, by lifecycle state "
+            "(aggregate scrape rollup)",
+        )
+        for st, n in sorted(roll_jobs.items()):
+            f_jobs.add(n, {"state": st})
+        roll_fams += [
+            f_jobs,
+            Family(
+                "ptt_fleet_queue_depth", "gauge",
+                "Jobs waiting across every backend FIFO",
+            ).add(roll_queue),
+            Family(
+                "ptt_fleet_active_jobs", "gauge",
+                "Jobs holding a device across the fleet",
+            ).add(roll_active),
+        ]
+    f_err = Family(
+        "ptt_fleet_scrape_errors", "gauge",
+        "Backends that could not be scraped this aggregate pass",
+    )
+    for addr in errors:
+        f_err.add(1, {"backend": addr})
+    roll_fams.append(f_err)
+    _ingest_exposition(
+        render_exposition(roll_fams), None, blocks, order
+    )
+
+    for addr in sorted(scraped):
+        text = scraped[addr]
+        if text is not None:
+            _ingest_exposition(text, addr, blocks, order)
+
+    lines: List[str] = []
+    for fam in order:
+        b = blocks[fam]
+        if b["help"]:
+            lines.append(f"# HELP {fam} {b['help']}")
+        if b["kind"]:
+            lines.append(f"# TYPE {fam} {b['kind']}")
+        for name, labels, val_s in b["lines"]:
+            lines.append(f"{name}{_fmt_labels(labels)} {val_s}")
+    return "\n".join(lines) + "\n"

@@ -309,6 +309,10 @@ def job_table(events: List[dict]) -> List[Dict[str, object]]:
             # job's own events.jsonl stream
             if e["engine_run_id"] not in row["run_ids"]:
                 row["run_ids"].append(e["engine_run_id"])
+        if isinstance(e.get("trace_id"), str):
+            # the fleet trace id (r22, v15): the join key into the
+            # dispatcher stream's route/failover/complete chain
+            row["trace_id"] = e["trace_id"]
         if ev == "job_submit":
             row["spec"] = e.get("spec", row["spec"])
         elif ev in ("job_start", "job_resume"):
@@ -339,19 +343,62 @@ def job_table(events: List[dict]) -> List[Dict[str, object]]:
     return list(jobs.values())
 
 
-def render_job_table(events: List[dict]) -> str:
+def fleet_job_index(fleet_events: List[dict]) -> Dict[str, dict]:
+    """Per-``trace_id`` routing facts from a DISPATCHER stream (r22,
+    v15): the backend that ultimately owned the job, the hop count
+    (1 initial placement + one per failover resubmission), and the
+    dispatcher-measured end-to-end latency from the ``complete``
+    event.  This is the join index ``render_job_table`` uses to add
+    fleet columns when a dispatcher stream rides along a backend
+    stream — the e2e-vs-on-device gap is the fleet's routing +
+    queueing overhead for that job."""
+    idx: Dict[str, dict] = {}
+
+    def row(tid: str) -> dict:
+        return idx.setdefault(
+            tid, {"backend": None, "hops": 1, "e2e_ms": None}
+        )
+
+    for e in fleet_events:
+        ev = e.get("event")
+        if ev == "route" and isinstance(e.get("trace_id"), str):
+            row(e["trace_id"])["backend"] = e.get("backend")
+        elif ev == "failover":
+            for tid in e.get("trace_ids") or []:
+                if isinstance(tid, str):
+                    row(tid)["hops"] = int(row(tid)["hops"]) + 1
+        elif ev == "complete" and isinstance(e.get("trace_id"), str):
+            r = row(e["trace_id"])
+            if e.get("backend"):
+                # the completing backend wins: after a failover it is
+                # not the one the route event named
+                r["backend"] = e.get("backend")
+            if isinstance(e.get("e2e_ms"), (int, float)):
+                r["e2e_ms"] = float(e["e2e_ms"])
+    return idx
+
+
+def render_job_table(
+    events: List[dict], fleet_events: List[dict] = None
+) -> str:
     """Markdown view of :func:`job_table` for a daemon stream.  The
     overhead columns are per-transition averages: frame write+stall
     seconds per suspend and restore seconds per resume (the two halves
     of one mesh context switch), rendered "—" for pre-v5 streams that
-    never measured them."""
+    never measured them.  With ``fleet_events`` (a dispatcher stream,
+    r22) the table gains the fleet columns — owning backend, hop
+    count, and the dispatcher-measured end-to-end seconds beside the
+    on-device wall — joined per job via its v15 ``trace_id``."""
     rows = job_table(events)
     if not rows:
         return "(no job_* events in this stream)"
+    fleet = fleet_job_index(fleet_events) if fleet_events else None
     lines = [
         "| job | spec | slices | suspends | wall s "
-        "| susp s (write+stall) | restore s | status |",
-        "|---|---|---|---|---|---|---|---|",
+        "| susp s (write+stall) | restore s | status |"
+        + (" backend | hops | e2e s |" if fleet is not None else ""),
+        "|---|---|---|---|---|---|---|---|"
+        + ("---|---|---|" if fleet is not None else ""),
     ]
     for r in rows:
         n_susp = int(r["suspends"])
@@ -370,9 +417,22 @@ def render_job_table(events: List[dict]) -> str:
         # suspended-slices sum is only a lower bound (no final slice)
         total_wall = r.get("wall_s") or r["slice_wall_s"]
         wall = f"{total_wall:.2f}" if total_wall else "—"
-        lines.append(
+        line = (
             f"| {r['job_id']} | {r['spec'] or '?'} | {r['slices']} "
             f"| {r['suspends']} | {wall} | {susp} | {rest} "
             f"| {r['status'] or 'in flight'} |"
         )
+        if fleet is not None:
+            fr = fleet.get(r.get("trace_id") or "", {})
+            e2e = fr.get("e2e_ms")
+            e2e_s = (
+                f"{e2e / 1000.0:.2f}"
+                if isinstance(e2e, (int, float))
+                else "—"
+            )
+            line += (
+                f" {fr.get('backend') or '—'} "
+                f"| {fr.get('hops') or '—'} | {e2e_s} |"
+            )
+        lines.append(line)
     return "\n".join(lines)

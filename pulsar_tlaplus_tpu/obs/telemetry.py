@@ -143,10 +143,35 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # persisted jobs were confirmed / adopted / typed lost against the
 # backends' authoritative job tables, and whether a torn
 # fleet_jobs.json was quarantined first).
+# v15 (round 22, the fleet observability plane): every accepted
+# submit is minted a ``trace_id`` by the dispatcher and the id is
+# stamped on every hop of the job's journey — the dispatcher's
+# ``route`` / ``replicate`` / ``failover`` / ``reconcile`` records,
+# the backend daemon's ``job_*`` lifecycle events (forwarded on the
+# wire), and every engine ``run_header`` (null on standalone runs;
+# REQUIRED at v15 like profile_sig / tenant / mode / warm so traced
+# and untraced trajectories always split) — which is what lets
+# ``obs/trace.py`` stitch one dispatcher stream plus N backend
+# streams into ONE Perfetto timeline with cross-backend flow arrows.
+# The dispatcher additionally emits latency observations so the
+# fixed-bucket histogram families (obs/metrics.py ``ptt_*_seconds``)
+# derive identically from a live scrape and a stream replay:
+# ``route`` records carry ``route_ms`` (decision) and ``ack_ms``
+# (submit acked end-to-end), ``failover`` records carry ``wall_ms``
+# and the failed-over jobs' ``trace_ids``, ``partition`` records
+# carry the reconcile pass ``wall_ms``, ``replicate`` records carry
+# the transfer ``wall_ms`` and the triggering job's ``trace_id`` —
+# and four NEW events: ``complete`` (the dispatcher observed a routed
+# job reach a terminal state: end-to-end ``e2e_ms`` from accept to
+# observed-terminal), ``relay`` (one watch-relay leg, ``leg_ms``),
+# ``hold`` / ``shed`` (the all-backends-down queue-and-hold admitting
+# or overflowing a submit), and ``persist_fail`` (a fleet_jobs.json
+# persist that stayed failed after the retry — the counter was
+# previously invisible to stream replay).
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -247,6 +272,31 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("reconcile", "state"): 14,
     ("partition", "backend"): 14,
     ("recover", "jobs"): 14,
+    # v15 (round 22): the distributed-tracing plane.  ``trace_id`` is
+    # REQUIRED on every dispatcher hop record, every daemon job_*
+    # lifecycle event, and every engine run_header (null outside a
+    # traced fleet/daemon context on the header; the daemon mints its
+    # own id for direct submits so job events always carry one) — and
+    # the latency fields behind the ``ptt_*_seconds`` histogram
+    # families ride the same records so stream replay re-bins
+    # identically to the live scrape.  All gated at 15 so every
+    # committed v14-and-older stream stays validator-clean.
+    ("route", "trace_id"): 15,
+    ("route", "route_ms"): 15,
+    ("route", "ack_ms"): 15,
+    ("replicate", "trace_id"): 15,
+    ("replicate", "wall_ms"): 15,
+    ("failover", "trace_ids"): 15,
+    ("failover", "wall_ms"): 15,
+    ("reconcile", "trace_id"): 15,
+    ("partition", "wall_ms"): 15,
+    ("job_submit", "trace_id"): 15,
+    ("job_start", "trace_id"): 15,
+    ("job_resume", "trace_id"): 15,
+    ("job_suspend", "trace_id"): 15,
+    ("job_result", "trace_id"): 15,
+    ("job_cancel", "trace_id"): 15,
+    ("run_header", "trace_id"): 15,
     ("admission", "action"): 10,
     ("admission", "tenant"): 10,
     ("auth", "action"): 10,
@@ -265,7 +315,7 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # hbm_budget — the tiered-store byte budget, null when untiered)
     "run_header": (
         "engine", "visited_impl", "config_sig", "profile_sig",
-        "hbm_budget", "tenant", "mode", "warm",
+        "hbm_budget", "tenant", "mode", "warm", "trace_id",
     ),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress
@@ -330,12 +380,12 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # live in the DAEMON's stream (service.jsonl) under the daemon's
     # run_id; the per-job engine events stream separately under each
     # slice's engine run_id (docs/service.md)
-    "job_submit": ("job_id", "spec"),
-    "job_start": ("job_id", "spec", "slice"),
-    "job_resume": ("job_id", "spec", "slice", "restore_s"),
-    "job_suspend": ("job_id", "slice", "slice_wall_s"),
-    "job_result": ("job_id", "status"),
-    "job_cancel": ("job_id",),
+    "job_submit": ("job_id", "spec", "trace_id"),
+    "job_start": ("job_id", "spec", "slice", "trace_id"),
+    "job_resume": ("job_id", "spec", "slice", "restore_s", "trace_id"),
+    "job_suspend": ("job_id", "slice", "slice_wall_s", "trace_id"),
+    "job_result": ("job_id", "status", "trace_id"),
+    "job_cancel": ("job_id", "trace_id"),
     # daemon lifecycle: start (socket, pid, warmed specs) / stop
     "serve": ("action",),
     # swarm simulation (r18, sim/engine.py): one record per segment
@@ -369,9 +419,13 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # everything, the sieve's whole point); ``failover`` is one
     # backend drain — the down backend and how many of its queued jobs
     # were resubmitted elsewhere through the submit_id dedup path
-    "route": ("backend", "tenant"),
-    "replicate": ("src", "dst", "blobs", "wire_bytes"),
-    "failover": ("backend", "resubmitted"),
+    "route": (
+        "backend", "tenant", "trace_id", "route_ms", "ack_ms",
+    ),
+    "replicate": (
+        "src", "dst", "blobs", "wire_bytes", "trace_id", "wall_ms",
+    ),
+    "failover": ("backend", "resubmitted", "trace_ids", "wall_ms"),
     # fleet survivability (r21, fleet/dispatcher.py): ``reconcile`` is
     # one lost job answered for by its rejoined backend — ``state`` is
     # the REAL state that replaced ``lost`` (done delivers the
@@ -383,9 +437,27 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     # every backend's authoritative job table (confirmed / adopted /
     # lost counts, plus whether a torn fleet_jobs.json was
     # quarantined first)
-    "reconcile": ("backend", "job_id", "state"),
-    "partition": ("backend",),
+    "reconcile": ("backend", "job_id", "state", "trace_id"),
+    "partition": ("backend", "wall_ms"),
     "recover": ("jobs",),
+    # fleet observability plane (r22, fleet/dispatcher.py): NEW at
+    # v15, so their required fields need no FIELD_SINCE gating (the
+    # names cannot appear in older streams).  ``complete`` is the
+    # dispatcher observing a routed job reach a terminal state —
+    # ``e2e_ms`` is accept-to-observed-terminal, the end-to-end job
+    # latency histogram's input; ``relay`` is one watch-relay leg
+    # (owner re-resolution cadence, ``leg_ms``); ``hold`` / ``shed``
+    # are the all-backends-down queue-and-hold admitting a submit
+    # into the bounded buffer vs overflowing it with the typed
+    # ``capacity`` rejection; ``persist_fail`` is a fleet_jobs.json
+    # persist that stayed failed after the retry-once path (``n`` is
+    # the cumulative counter, so replay derives the same
+    # ptt_fleet_persist_failures_total a live scrape reports).
+    "complete": ("job_id", "backend", "e2e_ms", "trace_id"),
+    "relay": ("job_id", "leg_ms", "trace_id"),
+    "hold": ("tenant", "held", "trace_id"),
+    "shed": ("tenant", "held", "trace_id"),
+    "persist_fail": ("n",),
 }
 
 

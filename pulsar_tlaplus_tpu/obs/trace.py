@@ -18,7 +18,15 @@ timeline viewable in https://ui.perfetto.dev (or ``chrome://tracing``):
 - **context-switch spans** filling every gap between two consecutive
   slices: the frame write of the suspending job plus the restore of the
   next (the ROADMAP's suspend/resume cost, measured — v5 streams
-  annotate the gap with ``restore_s``/``slice_wall_s`` breakdowns).
+  annotate the gap with ``restore_s``/``slice_wall_s`` breakdowns); and
+- **fleet dispatcher hops** (r22, schema v15): a dispatch stream's
+  route/replicate/failover/partition/recover records render as spans
+  of their measured ``ack_ms``/``wall_ms`` on a dedicated fleet track,
+  reconcile/hold/shed/complete as instants, watch-relay legs as spans
+  — and every v15 ``trace_id`` becomes a flow arrow (``ph`` s/t/f)
+  from the routing decision through each backend's job slices to the
+  terminal ``complete``, so a failover reads as ONE causal chain
+  crossing two backend tracks.
 
 Time alignment: every record's ``t`` is monotonic seconds since ITS
 stream opened, and a per-job stream restarts the clock every slice
@@ -80,6 +88,21 @@ def _instant(pid, tid, name, ts_s, args=None) -> dict:
     return e
 
 
+def _flow(ph: str, pid, tid, ts_s, trace_id: str) -> dict:
+    """One leg of a trace_id's flow arrow (``ph`` "s" start at the
+    routing decision, "t" step at each backend job slice, "f" finish
+    at the terminal ``complete``).  Chrome binds flow legs by
+    (cat, name, id), so all three share them."""
+    e = {
+        "ph": ph, "pid": pid, "tid": tid, "name": "trace",
+        "cat": "ptt.trace", "ts": round(ts_s * _US, 1),
+        "id": trace_id,
+    }
+    if ph == "f":
+        e["bp"] = "e"  # bind to the enclosing slice, not the next
+    return e
+
+
 def _run_anchors(events: List[dict]) -> Dict[str, float]:
     """run_id -> unix seconds of that run's t=0 (``wall_unix - t`` of
     the first anchored record), for per-run clock alignment."""
@@ -134,6 +157,9 @@ def job_slices(
             }
             if isinstance(e.get("restore_s"), (int, float)):
                 s["restore_s"] = float(e["restore_s"])
+            if isinstance(e.get("trace_id"), str):
+                # v15: the slice joins its fleet-wide causal chain
+                s["trace_id"] = e["trace_id"]
             open_by_job[(rid, jid)] = s
         elif ev in ("job_suspend", "job_result") and jid is not None:
             s = open_by_job.pop((rid, jid), None)
@@ -420,13 +446,19 @@ def _daemon_track_events(
                     k: s[k]
                     for k in (
                         "job_id", "slice", "end_event", "slice_wall_s",
-                        "restore_s",
+                        "restore_s", "trace_id",
                     )
                     if k in s
                 },
                 cat="job-slice",
             )
         )
+        if s.get("trace_id"):
+            # flow step: the fleet chain passes through this slice
+            out.append(
+                _flow("t", pid, DEVICE_TID, s["start_t"],
+                      s["trace_id"])
+            )
     for g in context_switches(slices):
         out.append(
             _span(
@@ -473,6 +505,197 @@ def _daemon_track_events(
     return out
 
 
+# dispatcher-side hop events rendered on the fleet track (r22); kept
+# OFF the engine-run threads so a dispatch stream's run_id doesn't
+# masquerade as an engine
+_FLEET_EVENTS = frozenset((
+    "route", "replicate", "failover", "partition", "recover",
+    "reconcile", "relay", "hold", "shed", "complete",
+))
+_FLEET_TID = 2
+
+
+def _ms(v) -> float:
+    return float(v) / 1000.0 if isinstance(v, (int, float)) else 0.0
+
+
+def _fleet_track_events(
+    pid: int, events: List[dict], offsets: Dict[str, float]
+) -> List[dict]:
+    """The dispatcher-hop track of a dispatch stream: routing
+    decisions, replication transfers, failover/reconcile windows and
+    watch-relay legs as spans of their measured durations (each hop
+    event is emitted at its END, so the span runs backwards from
+    ``t``), hold/shed/reconcile/complete as instants — plus the flow
+    "s"/"f" legs that anchor each trace_id's cross-stream arrow."""
+    out: List[dict] = [
+        _meta(pid, _FLEET_TID, "fleet (dispatcher hops)",
+              "thread_name")
+    ]
+    for e in events:
+        ev = e.get("event")
+        t = e.get("t")
+        if ev not in _FLEET_EVENTS or not isinstance(
+            t, (int, float)
+        ):
+            continue
+        t = float(t) + float(offsets.get(e.get("run_id"), 0.0))
+        jid6 = str(e.get("job_id") or "?")[:6]
+        if ev == "route":
+            # v15 ack_ms is the full arrival->ack path; pre-v15
+            # streams fall back to route_ms so old traces still span
+            dur = _ms(e.get("ack_ms", e.get("route_ms")))
+            out.append(
+                _span(
+                    pid, _FLEET_TID,
+                    f"route {jid6} -> {e.get('backend', '?')}",
+                    t - dur, dur,
+                    args={
+                        k: e[k]
+                        for k in (
+                            "backend", "tenant", "reason", "job_id",
+                            "route_ms", "ack_ms", "trace_id",
+                        )
+                        if k in e
+                    },
+                    cat="ptt.fleet",
+                )
+            )
+            if isinstance(e.get("trace_id"), str):
+                out.append(
+                    _flow("s", pid, _FLEET_TID, t - dur,
+                          e["trace_id"])
+                )
+        elif ev in ("replicate", "failover", "partition", "recover"):
+            dur = _ms(e.get("wall_ms"))
+            name = {
+                "replicate": (
+                    f"replicate {e.get('src', '?')} -> "
+                    f"{e.get('dst', '?')}"
+                ),
+                "failover": f"failover {e.get('backend', '?')}",
+                "partition": (
+                    f"partition {e.get('backend', '?')} reconciled"
+                ),
+                "recover": "recover",
+            }[ev]
+            out.append(
+                _span(
+                    pid, _FLEET_TID, name, t - dur, dur,
+                    args={
+                        k: e[k]
+                        for k in (
+                            "backend", "src", "dst", "blobs",
+                            "wire_bytes", "resubmitted", "trace_id",
+                            "trace_ids", "lost_jobs", "reconciled",
+                            "jobs", "confirmed", "adopted", "lost",
+                        )
+                        if k in e
+                    },
+                    cat="ptt.fleet",
+                )
+            )
+        elif ev == "relay":
+            dur = _ms(e.get("leg_ms"))
+            out.append(
+                _span(
+                    pid, _FLEET_TID, f"relay {jid6}", t - dur, dur,
+                    args={
+                        k: e[k]
+                        for k in ("job_id", "leg_ms", "trace_id")
+                        if k in e
+                    },
+                    cat="ptt.fleet",
+                )
+            )
+        elif ev == "complete":
+            out.append(
+                _instant(
+                    pid, _FLEET_TID, f"complete {jid6}", t,
+                    args={
+                        k: e[k]
+                        for k in (
+                            "job_id", "backend", "state", "e2e_ms",
+                            "trace_id",
+                        )
+                        if k in e
+                    },
+                )
+            )
+            if isinstance(e.get("trace_id"), str):
+                out.append(
+                    _flow("f", pid, _FLEET_TID, t, e["trace_id"])
+                )
+        else:  # reconcile / hold / shed
+            out.append(
+                _instant(
+                    pid, _FLEET_TID, f"{ev} {jid6}", t,
+                    args={
+                        k: e[k]
+                        for k in (
+                            "backend", "job_id", "state", "tenant",
+                            "held", "trace_id",
+                        )
+                        if k in e
+                    },
+                )
+            )
+    return out
+
+
+def trace_chains(
+    streams: List[Tuple[str, List[dict]]]
+) -> Dict[str, dict]:
+    """Join every stream's v15 ``trace_id`` stamps into per-chain
+    summaries: trace_id -> ``{routes, backends, streams, job_events,
+    run_headers, failovers, complete}``.  ``streams`` lists the
+    labels the id appears in (a failed-over job spans the dispatch
+    stream plus BOTH backend streams); ``backends`` the addrs its
+    route records named.  The chaos drill's chain-completeness
+    assertion and ``telemetry_report --jobs`` fleet columns both
+    consume this join."""
+    chains: Dict[str, dict] = {}
+
+    def chain(tid: str) -> dict:
+        return chains.setdefault(
+            tid,
+            {
+                "routes": 0, "backends": [], "streams": [],
+                "job_events": 0, "run_headers": 0, "failovers": 0,
+                "complete": False,
+            },
+        )
+
+    for label, events in streams:
+        for e in events:
+            ev = e.get("event") or ""
+            tids = []
+            if isinstance(e.get("trace_id"), str):
+                tids = [e["trace_id"]]
+            elif isinstance(e.get("trace_ids"), list):
+                tids = [
+                    t for t in e["trace_ids"] if isinstance(t, str)
+                ]
+            for tid in tids:
+                c = chain(tid)
+                if label not in c["streams"]:
+                    c["streams"].append(label)
+                if ev == "route":
+                    c["routes"] += 1
+                    b = e.get("backend")
+                    if b and b not in c["backends"]:
+                        c["backends"].append(b)
+                elif ev == "failover":
+                    c["failovers"] += 1
+                elif ev == "complete":
+                    c["complete"] = True
+                elif ev == "run_header":
+                    c["run_headers"] += 1
+                elif ev.startswith("job_"):
+                    c["job_events"] += 1
+    return chains
+
+
 def build_trace(
     streams: List[Tuple[str, List[dict]]]
 ) -> dict:
@@ -502,10 +725,16 @@ def build_trace(
         by_run: Dict[str, List[dict]] = {}
         run_order: List[str] = []
         has_jobs = False
+        has_fleet = False
         for e in events:
             ev = e.get("event", "")
             if ev.startswith("job_") or ev == "serve":
                 has_jobs = True
+                continue
+            if ev in _FLEET_EVENTS:
+                # dispatcher hops render on the fleet track, not as
+                # an engine-run thread
+                has_fleet = True
                 continue
             rid = e.get("run_id")
             if rid is None:
@@ -515,6 +744,13 @@ def build_trace(
                 run_order.append(rid)
             by_run[rid].append(e)
 
+        if has_fleet:
+            trace_events.extend(
+                _fleet_track_events(
+                    pid, events,
+                    {rid: a - t0 for rid, a in anchors.items()},
+                )
+            )
         if has_jobs:
             # per-run_id daemon clocks: a restart-appended stream
             # carries one run_id per daemon lifetime, each with its
@@ -581,7 +817,7 @@ def validate_trace(path_or_dict, label: str = "") -> List[str]:
         d.get("traceEvents"), list
     ):
         return [f"{label}: not a trace object (no traceEvents list)"]
-    known_ph = {"X", "B", "E", "C", "i", "I", "M"}
+    known_ph = {"X", "B", "E", "C", "i", "I", "M", "s", "t", "f"}
     for i, e in enumerate(d["traceEvents"]):
         where = f"{label}: traceEvents[{i}]"
         if not isinstance(e, dict):
@@ -596,6 +832,9 @@ def validate_trace(path_or_dict, label: str = "") -> List[str]:
                 errors.append(f"{where}: non-numeric {k} {e.get(k)!r}")
         if ph != "C" and not e.get("name"):
             errors.append(f"{where}: missing name")
+        if ph in ("s", "t", "f") and not e.get("id"):
+            # flow legs bind by id: an id-less leg renders nothing
+            errors.append(f"{where}: flow event missing id")
         if ph == "X":
             if (
                 not isinstance(e.get("dur"), (int, float))

@@ -260,10 +260,16 @@ class ServiceClient:
     def cancel(self, job_id: str) -> str:
         return self._request("cancel", job_id=job_id)["state"]
 
-    def metrics(self) -> str:
+    def metrics(self, aggregate: bool = False) -> str:
         """Prometheus text exposition of live daemon state (the r12
-        ``metrics`` verb; zero device syncs server-side)."""
-        return self._request("metrics")["metrics"]
+        ``metrics`` verb; zero device syncs server-side).  Against a
+        fleet dispatcher, ``aggregate=True`` scrapes every live
+        backend too and re-emits its families under a ``backend``
+        label beside the fleet rollups (r22); a single daemon
+        ignores the flag."""
+        return self._request(
+            "metrics", **({"aggregate": True} if aggregate else {})
+        )["metrics"]
 
     def shutdown(self) -> dict:
         return self._request("shutdown")

@@ -67,6 +67,13 @@ class Job:
     priority: int = 0
     deadline_unix: Optional[float] = None
     submit_id: Optional[str] = None
+    # distributed tracing (r22): the fleet dispatcher mints one
+    # trace_id per accepted submit and forwards it on the wire; a
+    # standalone daemon mints its own at submit.  It is echoed into
+    # every job_* telemetry event and the engine run_header, so the
+    # trace stitcher (obs/trace.py --fleet) joins dispatcher hops to
+    # backend slices across machines
+    trace_id: Optional[str] = None
     # workload mode (r18): "check" = exhaustive BFS (the default),
     # "simulate" = the streaming walker swarm (sim/engine.py) — a
     # simulation job time-slices at SEGMENT boundaries through the
@@ -160,6 +167,8 @@ class Job:
             # the dispatcher's routing table: `dispatch --recover`
             # reconciles against the listing by submit_id (r21)
             s["submit_id"] = self.submit_id
+        if self.trace_id:
+            s["trace_id"] = self.trace_id
         if self.warm_mode is not None:
             s["warm_mode"] = self.warm_mode
             s["warm_reason"] = self.warm_reason

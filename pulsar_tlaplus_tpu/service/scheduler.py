@@ -35,6 +35,7 @@ import os
 import shutil
 import threading
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -726,6 +727,7 @@ class Scheduler:
         mode: str = "check",
         sim: Optional[dict] = None,
         warm: bool = True,
+        trace_id: Optional[str] = None,
     ) -> Job:
         """Validate eagerly (bad specs/cfgs/invariants fail the submit,
         not the queue), deduplicate on the client's ``submit_id``
@@ -832,6 +834,10 @@ class Scheduler:
         elif mode == "check" and self.warm_store is not None:
             wplan = warm_plan.WarmPlan("cold", warm_plan.REASON_OPT_OUT)
         jid = jobmod.new_job_id()
+        # the fleet dispatcher forwards its minted trace_id on the
+        # wire; a standalone daemon mints its own, so every v15
+        # job_* event carries one either way (docs/observability.md)
+        trace_id = str(trace_id) if trace_id else uuid.uuid4().hex
         now = time.time()
         with self.cv:
             if submit_id:
@@ -867,6 +873,7 @@ class Scheduler:
                     else None
                 ),
                 submit_id=str(submit_id) if submit_id else None,
+                trace_id=trace_id,
                 mode=mode,
                 sim=sim_norm,
                 warm=bool(warm),
@@ -905,6 +912,7 @@ class Scheduler:
             "job_submit", job_id=jid, spec=spec, tenant=tenant,
             priority=int(priority), mode=mode,
             wall_unix=round(now, 3),
+            trace_id=trace_id,
         )
         self.tel.emit(
             "admission", action="admit", tenant=tenant, job_id=jid,
@@ -1367,6 +1375,7 @@ class Scheduler:
                     "job_resume",
                     job_id=job.job_id, spec=job.spec,
                     slice=job.slices, restore_s=float(restore_s),
+                    trace_id=job.trace_id,
                 )
             if job.cancel_requested:
                 return "cancelled"
@@ -1456,6 +1465,7 @@ class Scheduler:
             self.tel.emit(
                 "job_start",
                 job_id=job.job_id, spec=job.spec, slice=job.slices,
+                trace_id=job.trace_id,
             )
         self._log(
             f"job {job.job_id}: slice {job.slices} "
@@ -1472,6 +1482,8 @@ class Scheduler:
         # tenant identity on every slice's engine run header (schema
         # v10 run_header.tenant — per-tenant attribution end to end)
         ck.tenant = job.tenant
+        # distributed-trace identity (schema v15 run_header.trace_id)
+        ck.trace_id = job.trace_id
         # warm attribution (schema v12 run_header.warm) + the final
         # frame a clean completion leaves as its reseed artifact
         ck.warm = (
@@ -1529,6 +1541,7 @@ class Scheduler:
                 restore_s=float(
                     (ck.last_stats or {}).get("restore_s") or 0.0
                 ),
+                trace_id=job.trace_id,
             )
         job.wall_s = float(r.wall_s)
         if r.stop_reason == "suspended":
@@ -1565,6 +1578,7 @@ class Scheduler:
                 suspend_extra["engine_run_id"] = ck._run_id
             self.tel.emit(
                 "job_suspend", job_id=job.job_id, slice=job.slices,
+                trace_id=job.trace_id,
                 **suspend_extra,
             )
             self._log(
@@ -1626,6 +1640,7 @@ class Scheduler:
             self.tel.emit(
                 "job_start",
                 job_id=job.job_id, spec=job.spec, slice=job.slices,
+                trace_id=job.trace_id,
             )
         self._log(
             f"job {job.job_id}: sim slice {job.slices} "
@@ -1634,6 +1649,7 @@ class Scheduler:
         eng.checkpoint_path = job.frame_path
         eng.time_budget_s = remaining
         eng.tenant = job.tenant
+        eng.trace_id = job.trace_id
         eng._telemetry_arg = job.events_path
         prev_wall = float(job.wall_s)
         hook = self._mk_hook(
@@ -1663,6 +1679,7 @@ class Scheduler:
                 "job_resume",
                 job_id=job.job_id, spec=job.spec, slice=job.slices,
                 restore_s=0.0,
+                trace_id=job.trace_id,
             )
         job.wall_s = float(r.wall_s)
         if r.stop_reason == "suspended":
@@ -1687,6 +1704,7 @@ class Scheduler:
                 suspend_extra["engine_run_id"] = eng._run_id
             self.tel.emit(
                 "job_suspend", job_id=job.job_id, slice=job.slices,
+                trace_id=job.trace_id,
                 **suspend_extra,
             )
             self._log(
@@ -1911,6 +1929,7 @@ class Scheduler:
             # table's denominator; slice_wall_s sums only cover the
             # suspended slices
             wall_s=round(float(job.wall_s), 3),
+            trace_id=job.trace_id,
             # the final slice's engine run id (join key into the
             # per-job stream, like job_suspend.engine_run_id)
             **(
@@ -1920,4 +1939,7 @@ class Scheduler:
             ),
         )
         if state == jobmod.CANCELLED:
-            self.tel.emit("job_cancel", job_id=job.job_id)
+            self.tel.emit(
+                "job_cancel", job_id=job.job_id,
+                trace_id=job.trace_id,
+            )

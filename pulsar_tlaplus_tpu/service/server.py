@@ -483,12 +483,18 @@ class ServiceDaemon:
             ),
             deadline_s=req.get("deadline_s"),
             submit_id=req.get("submit_id"),
+            # fleet trace propagation (r22): the dispatcher's minted
+            # trace_id rides the wire so this backend's job_* events
+            # and run_headers join the fleet-wide chain; absent
+            # (standalone submit), the scheduler mints its own
+            trace_id=req.get("trace_id"),
         )
         protocol.send_json(
             w,
             {
                 "ok": True, "job_id": job.job_id, "state": job.state,
                 "tenant": job.tenant,
+                "trace_id": job.trace_id,
                 # the reuse plan, so `submit` can print it up front
                 **(
                     {

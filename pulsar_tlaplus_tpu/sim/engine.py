@@ -646,6 +646,8 @@ class StreamingSimulator:
             hbm_budget=None,
             tenant=self.tenant,
             warm=getattr(self, "warm", None),
+            # v15: distributed-trace identity (None outside the daemon)
+            trace_id=getattr(self, "trace_id", None),
             wall_unix=round(time.time(), 3),
             n_walkers=self.B,
             depth=self.T,

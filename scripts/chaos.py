@@ -884,8 +884,12 @@ def run_fleet_chaos_v2(
 
     Afterwards: no acked job lost or double-run, and the dispatcher's
     appended multi-incarnation stream plus both backend streams are
-    v14-validator-clean.  Raises :class:`ChaosFailure` on any broken
-    invariant."""
+    v15-validator-clean; every acked submit's ``trace_id`` chains
+    from its dispatcher ``route`` event into backend ``job_*`` echoes
+    (r22 distributed tracing), at least one chain closes with a
+    ``complete`` event, and the three streams export as one
+    validator-clean Perfetto trace (``fleet_trace.json`` in the state
+    dir).  Raises :class:`ChaosFailure` on any broken invariant."""
     import signal as signalmod
 
     from pulsar_tlaplus_tpu.obs import metrics as obs_metrics
@@ -1257,6 +1261,67 @@ def run_fleet_chaos_v2(
     if stream_errors:
         raise ChaosFailure(f"stream violations: {stream_errors}")
     report["streams_validated"] = 3
+
+    # ---- r22: the surviving streams STITCH — every acked submit's
+    # trace_id chains from its dispatcher route event into backend
+    # job_* events, and the three streams export as ONE validator-
+    # clean Perfetto trace (docs/observability.md, Fleet plane) ----
+    from pulsar_tlaplus_tpu.obs import report as report_mod
+    from pulsar_tlaplus_tpu.obs import trace as trace_mod
+
+    stitched = []
+    for lbl, p in [
+        ("dispatch", os.path.join(disp_dir, "dispatch.jsonl"))
+    ] + [(f"backend{i}", c.telemetry_path)
+         for i, c in enumerate(configs)]:
+        evs, errs = report_mod.load_events(p)
+        if errs:
+            raise ChaosFailure(f"{p}: unreadable lines: {errs}")
+        stitched.append((lbl, evs))
+    chains = trace_mod.trace_chains(stitched)
+    routed = [
+        e["trace_id"] for e in stitched[0][1]
+        if e.get("event") == "route"
+        and isinstance(e.get("trace_id"), str)
+    ]
+    if len(set(routed)) < len(acked):
+        raise ChaosFailure(
+            f"dispatcher stream routed {len(set(routed))} distinct "
+            f"trace_id(s) for {len(acked)} acked submit(s)"
+        )
+    for tid in routed:
+        ch = chains.get(tid)
+        if ch is None or ch["routes"] < 1:
+            raise ChaosFailure(
+                f"trace {tid} routed but absent from trace_chains"
+            )
+        echoed = [s for s in ch["streams"] if s != "dispatch"]
+        if not echoed or ch["job_events"] < 1:
+            raise ChaosFailure(
+                f"trace {tid} never echoed by a backend — chain "
+                f"broken at the dispatcher hop ({ch})"
+            )
+    if not any(
+        ch["complete"] for ch in chains.values()
+    ):
+        raise ChaosFailure(
+            "no trace chain closed with a complete event — the "
+            "job sweep never emitted e2e latencies"
+        )
+    trace_path = os.path.join(state_dir, "fleet_trace.json")
+    trace_mod.write_trace(stitched, trace_path)
+    trace_errors = trace_mod.validate_trace(trace_path)
+    if trace_errors:
+        raise ChaosFailure(
+            f"stitched Perfetto trace invalid: {trace_errors}"
+        )
+    report["trace_chains"] = len(chains)
+    log(
+        f"r22: {len(set(routed))} routed trace chain(s) stitch "
+        "dispatcher->backend; Perfetto export validator-clean "
+        f"({trace_path})"
+    )
+
     log(
         "PASS: kill -9 recovery exactly-once, partition reconciled, "
         "flap hysteresis held, torn replication verified, "

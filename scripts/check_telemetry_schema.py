@@ -98,6 +98,20 @@ the real state that replaced ``lost``), ``partition`` (a drained
 backend rejoined still holding its jobs), ``recover`` (a ``dispatch
 --recover`` pass with its confirmed/adopted/lost counts) — all
 FIELD_SINCE-gated so committed v13-and-older streams stay clean.
+r22: v15 streams carry the distributed-tracing envelope — every
+``job_*`` event, ``run_header``, and dispatcher hop
+(``route``/``replicate``/``failover``/``reconcile``) carries the
+job's ``trace_id`` (null where no fleet minted one), ``route``
+carries the split ``route_ms``/``ack_ms`` decision-vs-ack latencies,
+and the new ``complete``/``relay``/``hold``/``shed``/``persist_fail``
+events close the job, time the watch-relay legs, and make the
+dispatcher's hold/shed/persist counters stream-derivable
+(``persist_fail`` carries the CUMULATIVE count) — all
+FIELD_SINCE-gated.  ``--metrics`` validates Prometheus exposition
+text files (``cli.py metrics`` output) instead: TYPE-histogram
+families must carry cumulative monotone buckets ending at ``+Inf``,
+a ``_count`` equal to the ``+Inf`` bucket, and a ``_sum`` inside the
+bounds the buckets admit (obs/metrics.py ``validate_exposition``).
 
 Exit status: 0 clean, 1 violations (listed on stderr), 2 usage.
 """
@@ -465,6 +479,12 @@ def main(argv=None) -> int:
         "(serve --tokens) and validate their shape (service/auth.py)",
     )
     ap.add_argument(
+        "--metrics", action="store_true",
+        help="treat the files as Prometheus exposition text (cli.py "
+        "metrics output) and run the histogram-consistency "
+        "cross-check (obs/metrics.py validate_exposition)",
+    )
+    ap.add_argument(
         "--warm", action="store_true",
         help="treat the files as warm-artifact dirs (or their "
         "manifest.json) and validate manifest shape + SHA-256 "
@@ -481,7 +501,17 @@ def main(argv=None) -> int:
         ap.error("nothing to validate (pass files or --all-bench)")
     errors: List[str] = []
     for p in files:
-        if args.warm:
+        if args.metrics:
+            from pulsar_tlaplus_tpu.obs.metrics import (
+                validate_exposition,
+            )
+
+            try:
+                with open(p) as fh:
+                    errors += validate_exposition(fh.read(), label=p)
+            except OSError as e:
+                errors += [f"{p}: unreadable ({e})"]
+        elif args.warm:
             from pulsar_tlaplus_tpu.warm.store import validate_artifact
 
             errors += validate_artifact(p)

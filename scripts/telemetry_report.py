@@ -58,7 +58,11 @@ def main(argv=None) -> int:
         "--jobs", action="store_true",
         help="render the per-job lifecycle table of a checker-daemon "
         "stream (schema v4 job_* events; v5 adds the per-slice "
-        "suspend/restore overhead columns — docs/service.md)",
+        "suspend/restore overhead columns — docs/service.md); when a "
+        "dispatcher stream rides along via --compare the table gains "
+        "the fleet columns — owning backend, hop count, end-to-end "
+        "seconds vs on-device wall — joined per job by its v15 "
+        "trace_id (docs/observability.md)",
     )
     ap.add_argument(
         "--attribution", action="store_true",
@@ -114,7 +118,21 @@ def main(argv=None) -> int:
         return 0
 
     if args.jobs:
-        print(report.render_job_table(streams[0][1]))
+        # auto-detect which stream is the dispatcher (it carries the
+        # route events) — either argument order works
+        fleet_evs = None
+        job_evs = None
+        for _lbl, evs in streams:
+            if any(e.get("event") == "route" for e in evs):
+                fleet_evs = fleet_evs if fleet_evs is not None else evs
+            elif job_evs is None:
+                job_evs = evs
+        print(
+            report.render_job_table(
+                job_evs if job_evs is not None else streams[0][1],
+                fleet_events=fleet_evs,
+            )
+        )
         return 0
 
     if args.attribution:

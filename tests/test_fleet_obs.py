@@ -1,0 +1,685 @@
+"""Fleet observability plane (ISSUE 18, r22): distributed tracing,
+aggregated metrics with latency histograms, and the flight deck.
+
+The acceptance bars (docs/observability.md, "Fleet plane"):
+
+- one ``trace_id`` per accepted submit, minted at the dispatcher,
+  stamped on every hop: the ``route`` record (with the split
+  ``route_ms``/``ack_ms`` decision-vs-ack latencies), the backend's
+  ``job_*`` echoes, the engine slice ``run_header``s, and the closing
+  ``complete`` record with the wall-clock end-to-end latency — and a
+  retried ``submit_id`` dedups to the SAME trace;
+- a failed-over job is ONE chain: the ``failover`` record carries the
+  affected ``trace_ids`` and the id spans both backends' streams;
+- ``metrics --aggregate`` re-emits every live backend's families
+  under a ``backend`` label beside fleet rollups and well-formed
+  fixed-bucket histograms; a backend down mid-scrape degrades to
+  ``ptt_fleet_scrape_errors`` instead of failing the scrape;
+- the ``ptt_fleet_*`` families — histograms included — render
+  IDENTICALLY from the live dispatcher and a replay of its stream
+  (the r12 live-vs-stream contract extended to the fleet tier,
+  closing the held_sheds/persist_failures replay gaps);
+- the stitched Perfetto export (dispatcher stream + backend streams)
+  validates clean and carries flow arrows binding each job's spans
+  across process tracks;
+- ``top --dispatch`` renders the whole fleet from one poll.
+
+The schema-level pieces (v15 trace_id gating, the ``--metrics``
+histogram-consistency validator, the ``--jobs`` fleet columns) are
+unit-tested here against synthetic streams; the live assertions ride
+a real 2-backend mini fleet.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from pulsar_tlaplus_tpu.fleet.dispatcher import (
+    FleetConfig,
+    FleetDispatcher,
+)
+from pulsar_tlaplus_tpu.obs import metrics as metrics_mod
+from pulsar_tlaplus_tpu.obs import report as report_mod
+from pulsar_tlaplus_tpu.obs import top as top_mod
+from pulsar_tlaplus_tpu.obs import trace as trace_mod
+from pulsar_tlaplus_tpu.obs.telemetry import SCHEMA_VERSION
+from pulsar_tlaplus_tpu.service import jobs as jobmod
+from pulsar_tlaplus_tpu.service.client import ServiceClient
+from pulsar_tlaplus_tpu.service.server import ServiceDaemon
+
+from tests.test_service import (  # noqa: F401  (fixtures by name)
+    _config,
+    _load_script,
+    assert_result_matches_solo,
+    cfg_dir,
+    checker_mod,
+    pool,
+    solo_compaction,
+)
+
+
+def _events(path):
+    evs, _errs = report_mod.load_events(path)
+    return evs
+
+def _wait(pred, timeout=60.0, interval=0.1, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def obs_fleet(tmp_path_factory, pool):
+    """One 2-backend fleet for the module (the test_fleet shape:
+    backend0 holds the warmed shared pool, backend1 compiles its
+    own); health ticks fast so the job sweep emits ``complete``
+    records promptly."""
+    root = tmp_path_factory.mktemp("obsfleet")
+    configs = [
+        _config(root / "b0", slice_s=0.3),
+        _config(root / "b1", slice_s=0.3),
+    ]
+    daemons = [
+        ServiceDaemon(configs[0], pool=pool),
+        ServiceDaemon(configs[1]),
+    ]
+    for d in daemons:
+        d.start()
+    fc = FleetConfig(
+        state_dir=str(root / "disp"),
+        backends=tuple(c.socket_path for c in configs),
+        health_interval_s=0.2,
+        fail_after=2,
+        backend_timeout_s=5.0,
+    )
+    disp = FleetDispatcher(fc)
+    disp.start()
+    cl = ServiceClient(fc.socket_path, timeout=240.0)
+    state = dict(
+        daemons=daemons, configs=configs, disp=disp, client=cl,
+        addrs=[c.socket_path for c in configs], fc=fc,
+        dispatch_stream=os.path.join(fc.state_dir, "dispatch.jsonl"),
+    )
+    try:
+        yield state
+    finally:
+        disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+
+
+# ---- histogram math (the metrics layer, no fleet needed) ------------
+
+
+def test_histogram_buckets_cumulative_and_quantiles():
+    """Fixed-bucket math: samples land in the right ``le`` bucket,
+    ``cumulative()`` ends at +Inf == count, and the interpolated
+    quantiles bracket the observations."""
+    h = metrics_mod.Histogram()
+    assert h.bounds == metrics_mod.LATENCY_BUCKETS_S
+    # 3ms -> the (0.0025, 0.005] bucket; 40ms -> (0.025, 0.05];
+    # 500s -> the +Inf overflow bucket
+    for v in (0.003, 0.003, 0.040, 500.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(0.003 + 0.003 + 0.040 + 500.0)
+    cum = h.cumulative()
+    assert cum[-1] == ("+Inf", 4)
+    by_le = dict(cum)
+    assert by_le["0.0025"] == 0
+    assert by_le["0.005"] == 2
+    assert by_le["0.05"] == 3
+    assert by_le["120"] == 3  # the 500s sample only in +Inf
+
+    pairs = [(float(le), n) for le, n in cum[:-1]]
+    pairs.append((float("inf"), 4))
+    p50 = metrics_mod.histogram_quantile(0.5, pairs)
+    assert 0.0025 <= p50 <= 0.005
+    # a quantile landing in the +Inf bucket floors at the largest
+    # finite edge instead of fabricating an unbounded value
+    p99 = metrics_mod.histogram_quantile(0.99, pairs)
+    assert p99 == pytest.approx(120.0)
+    assert metrics_mod.histogram_quantile(0.5, []) is None
+
+
+def test_fleet_hists_from_events_bins_ms_fields():
+    """Stream replay derives the six ``ptt_fleet_*_seconds``
+    histograms from the v15 ``*_ms`` fields — non-numeric latencies
+    (an adopted job's null ``e2e_ms``) are skipped, never crash."""
+    events = [
+        {"event": "route", "route_ms": 3.0, "ack_ms": 12.0},
+        {"event": "complete", "e2e_ms": 800.0},
+        {"event": "complete", "e2e_ms": None},  # adopted job
+        {"event": "relay", "leg_ms": 40.0},
+        {"event": "failover", "wall_ms": 90.0},
+        {"event": "partition", "wall_ms": 150.0},
+    ]
+    hists = metrics_mod.fleet_hists_from_events(events)
+    assert set(hists) == {
+        name for name, _h, _e, _f in metrics_mod.FLEET_HIST_SPECS
+    }
+    assert hists["ptt_fleet_route_seconds"].count == 1
+    assert hists["ptt_fleet_submit_ack_seconds"].count == 1
+    assert hists["ptt_fleet_job_e2e_seconds"].count == 1  # null skipped
+    assert hists["ptt_fleet_watch_leg_seconds"].count == 1
+    assert hists["ptt_fleet_failover_seconds"].count == 1
+    assert hists["ptt_fleet_reconcile_seconds"].count == 1
+    # ms -> s binning: 800ms lands in the (0.5, 1.0] bucket
+    by_le = dict(hists["ptt_fleet_job_e2e_seconds"].cumulative())
+    assert by_le["0.5"] == 0 and by_le["1"] == 1
+
+
+# ---- exposition validator (satellite: positive + negative) ----------
+
+
+def _hist_exposition() -> str:
+    h = metrics_mod.Histogram()
+    for v in (0.003, 0.040, 0.041):
+        h.observe(v)
+    fam = metrics_mod.Family(
+        "ptt_fleet_route_seconds", "histogram", "route decision"
+    ).add_hist(h)
+    return metrics_mod.render_exposition([fam])
+
+
+def test_validate_exposition_clean_on_rendered_histogram():
+    text = _hist_exposition()
+    assert metrics_mod.validate_exposition(text) == []
+
+
+def test_validate_exposition_flags_tampered_histograms():
+    """Each consistency rule trips on the matching corruption: a
+    dropped +Inf bucket, a ``_count`` that disagrees with it,
+    non-cumulative buckets, and a ``_sum`` outside what the buckets
+    admit."""
+    text = _hist_exposition()
+
+    no_inf = "\n".join(
+        ln for ln in text.splitlines() if 'le="+Inf"' not in ln
+    )
+    assert any(
+        "no +Inf bucket" in e
+        for e in metrics_mod.validate_exposition(no_inf)
+    )
+
+    bad_count = text.replace("ptt_fleet_route_seconds_count 3",
+                             "ptt_fleet_route_seconds_count 5")
+    assert any(
+        "_count" in e
+        for e in metrics_mod.validate_exposition(bad_count)
+    )
+
+    # shrink one mid-series cumulative bucket below its predecessor
+    shrunk = text.replace(
+        'ptt_fleet_route_seconds_bucket{le="0.05"} 3',
+        'ptt_fleet_route_seconds_bucket{le="0.05"} 0',
+    )
+    assert shrunk != text
+    assert any(
+        "cumulative" in e
+        for e in metrics_mod.validate_exposition(shrunk)
+    )
+
+    # all three observations sit inside finite buckets, so a huge
+    # _sum breaks the bucket ceiling; a negative one the floor
+    big = text.replace("ptt_fleet_route_seconds_sum 0.084",
+                       "ptt_fleet_route_seconds_sum 999")
+    assert big != text
+    assert any(
+        "ceiling" in e for e in metrics_mod.validate_exposition(big)
+    )
+
+
+def test_check_schema_metrics_flag(tmp_path, checker_mod):
+    """``check_telemetry_schema.py --metrics`` exits 0 on a clean
+    exposition file and 1 on a tampered one."""
+    good = tmp_path / "good.prom"
+    good.write_text(_hist_exposition())
+    assert checker_mod.main(["--metrics", str(good)]) == 0
+    bad = tmp_path / "bad.prom"
+    bad.write_text(
+        _hist_exposition().replace(
+            "ptt_fleet_route_seconds_count 3",
+            "ptt_fleet_route_seconds_count 7",
+        )
+    )
+    assert checker_mod.main(["--metrics", str(bad)]) == 1
+
+
+# ---- v15 stream gating: trace_id required, null legal ---------------
+
+
+def _line(seq, **rec):
+    base = {
+        "v": SCHEMA_VERSION, "event": "?", "t": float(seq) / 10.0,
+        "seq": seq, "run_id": "r-obs",
+    }
+    base.update(rec)
+    return json.dumps(base)
+
+
+def test_v15_requires_trace_id_on_job_and_fleet_events(
+    tmp_path, checker_mod
+):
+    """The FIELD_SINCE gate: a v15 ``job_submit`` (or ``route``)
+    without the trace envelope fails; present-with-null passes; a
+    committed v14 record without it stays clean."""
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text("\n".join([
+        _line(0, event="job_submit", job_id="j1", spec="compaction",
+              trace_id=None),
+        _line(1, event="route", backend="b0", tenant="local",
+              trace_id="t" * 32, route_ms=1.0, ack_ms=2.0),
+        _line(2, event="complete", job_id="j1", backend="b0",
+              e2e_ms=5.0, trace_id="t" * 32),
+        _line(3, event="persist_fail", n=1),
+    ]) + "\n")
+    assert checker_mod.validate_stream(str(ok)) == []
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join([
+        _line(0, event="job_submit", job_id="j1", spec="compaction"),
+        _line(1, event="route", backend="b0", tenant="local"),
+    ]) + "\n")
+    errs = checker_mod.validate_stream(str(bad))
+    assert any("job_submit missing" in e and "trace_id" in e
+               for e in errs)
+    assert any("route missing" in e for e in errs)
+
+    old = tmp_path / "old.jsonl"
+    old.write_text(
+        _line(0, v=14, event="job_submit", job_id="j1",
+              spec="compaction") + "\n"
+    )
+    assert checker_mod.validate_stream(str(old)) == []
+
+
+# ---- --jobs fleet columns (trace_id join, synthetic streams) --------
+
+
+def test_job_table_fleet_columns_join_by_trace_id():
+    """``render_job_table`` with a dispatcher stream beside it: the
+    owning backend comes from the chain (the COMPLETING backend after
+    a failover, not the first route), hops = 1 + failovers, and the
+    dispatcher-measured e2e seconds land beside the on-device wall."""
+    tid = "a" * 32
+    backend_events = [
+        {"event": "job_submit", "job_id": "j1", "spec": "compaction",
+         "trace_id": tid},
+        {"event": "job_start", "job_id": "j1", "spec": "compaction",
+         "slice": 1, "trace_id": tid},
+        {"event": "job_result", "job_id": "j1", "status": "ok",
+         "wall_s": 1.5, "trace_id": tid},
+    ]
+    fleet_events = [
+        {"event": "route", "backend": "sock-A", "trace_id": tid},
+        {"event": "failover", "backend": "sock-A",
+         "trace_ids": [tid]},
+        {"event": "complete", "job_id": "j1", "backend": "sock-B",
+         "e2e_ms": 2500.0, "trace_id": tid},
+    ]
+    idx = report_mod.fleet_job_index(fleet_events)
+    assert idx[tid] == {
+        "backend": "sock-B", "hops": 2, "e2e_ms": 2500.0,
+    }
+    table = report_mod.render_job_table(
+        backend_events, fleet_events=fleet_events
+    )
+    assert "backend | hops | e2e s |" in table.splitlines()[0]
+    assert "sock-B | 2 | 2.50 |" in table
+    # without the dispatcher stream the table keeps its old shape
+    plain = report_mod.render_job_table(backend_events)
+    assert "backend" not in plain.splitlines()[0]
+
+
+# ---- live mini fleet: trace_id end to end ---------------------------
+
+
+def test_trace_id_submit_to_engine_and_complete(
+    obs_fleet, cfg_dir, solo_compaction
+):
+    """One submit through the dispatcher: the reply's ``trace_id``
+    reappears on the route record (with ack >= route decision
+    latency), every backend ``job_*`` echo, the engine slice
+    ``run_header``, and the sweep's ``complete`` record with a
+    positive wall-clock e2e — and a ``submit_id`` retry dedups to
+    the SAME trace."""
+    cl = obs_fleet["client"]
+    r = cl.submit(
+        "compaction", str(cfg_dir / "small_compaction.cfg"),
+        invariants=[], warm=False, submit_id="obs-trace-1",
+        full=True,
+    )
+    tid, jid = r["trace_id"], r["job_id"]
+    assert isinstance(tid, str) and len(tid) == 32
+    w = cl.wait(jid, timeout=600.0)
+    assert w["state"] == jobmod.DONE
+    assert_result_matches_solo(
+        type("R", (), {
+            "result": w.get("result"), "state": w.get("state"),
+            "error": w.get("error"),
+        })(),
+        solo_compaction,
+    )
+
+    again = cl.submit(
+        "compaction", str(cfg_dir / "small_compaction.cfg"),
+        invariants=[], warm=False, submit_id="obs-trace-1",
+        full=True,
+    )
+    assert again["job_id"] == jid
+    assert again["trace_id"] == tid
+
+    routes = [
+        e for e in _events(obs_fleet["dispatch_stream"])
+        if e.get("event") == "route" and e.get("trace_id") == tid
+    ]
+    assert routes
+    for e in routes:
+        assert e["ack_ms"] >= e["route_ms"] >= 0.0
+
+    def completes():
+        return [
+            e for e in _events(obs_fleet["dispatch_stream"])
+            if e.get("event") == "complete"
+            and e.get("trace_id") == tid
+        ]
+
+    _wait(completes, timeout=60.0, what="complete record (job sweep)")
+    comp = completes()[0]
+    assert comp["backend"] == r["backend"]
+    assert comp["job_id"] == jid
+    assert comp["e2e_ms"] > 0.0
+
+    owner_cfg = obs_fleet["configs"][
+        obs_fleet["addrs"].index(r["backend"])
+    ]
+    job_events = [
+        e for e in _events(owner_cfg.telemetry_path)
+        if str(e.get("event", "")).startswith("job_")
+        and e.get("job_id") == jid
+    ]
+    kinds = {e["event"] for e in job_events}
+    assert {"job_submit", "job_result"} <= kinds
+    assert all(e.get("trace_id") == tid for e in job_events)
+
+    # the engine slice's run_header carries the id too — the last
+    # stitch between the fleet chain and the on-device timeline
+    engine_stream = os.path.join(
+        owner_cfg.state_dir, "jobs", jid, "events.jsonl"
+    )
+    headers = [
+        e for e in _events(engine_stream)
+        if e.get("event") == "run_header"
+    ]
+    assert headers and all(h.get("trace_id") == tid for h in headers)
+
+    # the live histograms saw the decision/ack/e2e samples
+    snap = obs_fleet["disp"].metrics_snapshot()
+    for fam in (
+        "ptt_fleet_route_seconds", "ptt_fleet_submit_ack_seconds",
+        "ptt_fleet_job_e2e_seconds",
+    ):
+        assert snap["hists"][fam].count >= 1, fam
+
+
+# ---- live mini fleet: aggregate scrape + replay parity --------------
+
+
+def test_aggregate_scrape_labels_rollups_and_wellformed_hists(
+    obs_fleet,
+):
+    """``metrics --aggregate``: backend families re-emitted under a
+    ``backend`` label, fleet job rollups summed across backends, the
+    dispatcher's histogram families well-formed under the
+    ``--metrics`` consistency validator, and no scrape errors while
+    everyone is up."""
+    text = obs_fleet["client"].metrics(aggregate=True)
+    assert metrics_mod.validate_exposition(text, "aggregate") == []
+    fams, types = metrics_mod.parse_exposition(text)
+    # backend job tables ride in under their own label (a backend
+    # with an empty table exports no ptt_jobs — absent beats zero —
+    # so assert the label set is non-empty and well-formed, not full)
+    job_labels = {
+        lb.get("backend") for lb, _v in fams.get("ptt_jobs", [])
+    }
+    assert job_labels and job_labels <= set(obs_fleet["addrs"])
+    # rollups summed across the scrape
+    assert types.get("ptt_fleet_jobs") == "gauge"
+    assert sum(v for _lb, v in fams["ptt_fleet_jobs"]) >= 1.0
+    assert "ptt_fleet_queue_depth" in fams
+    # the dispatcher's own histograms, unlabelled
+    assert types.get("ptt_fleet_route_seconds") == "histogram"
+    own_buckets = [
+        (lb, v)
+        for lb, v in fams["ptt_fleet_route_seconds_bucket"]
+        if not lb.get("backend")
+    ]
+    assert own_buckets[-1][0]["le"] == "+Inf"
+    assert "ptt_fleet_scrape_errors" not in fams  # everyone answered
+
+
+def test_fleet_families_live_vs_stream_replay_parity(obs_fleet):
+    """The r12 contract at the fleet tier (satellite): every
+    ``ptt_fleet_*`` family the live dispatcher exports derives
+    family-for-family — and for the counters + histograms below,
+    value-for-value — from a replay of its own stream.  This pins
+    the previously stream-invisible signals (holds, held sheds,
+    persist failures) and the histogram re-binning."""
+    live_fams, live_types = metrics_mod.parse_exposition(
+        obs_fleet["client"].metrics()
+    )
+    stream_text = metrics_mod.render_stream_metrics(
+        _events(obs_fleet["dispatch_stream"])
+    )
+    st_fams, st_types = metrics_mod.parse_exposition(stream_text)
+
+    live_fleet = {n for n in live_types if n.startswith("ptt_fleet_")}
+    st_fleet = {n for n in st_types if n.startswith("ptt_fleet_")}
+    assert live_fleet == st_fleet
+    assert "ptt_fleet_job_e2e_seconds" in live_fleet
+
+    # counters agree exactly (the stream is the ledger of record)
+    for fam in ("ptt_fleet_routes_total",):
+        live_total = sum(v for _lb, v in live_fams.get(fam, []))
+        st_total = sum(v for _lb, v in st_fams.get(fam, []))
+        assert live_total == st_total, fam
+
+    # histograms re-bin identically: same bucket lines, same counts
+    for fam, kind in sorted(live_types.items()):
+        if kind != "histogram":
+            continue
+        for suffix in ("_bucket", "_count", "_sum"):
+            live_s = sorted(
+                (tuple(sorted(lb.items())), v)
+                for lb, v in live_fams.get(fam + suffix, [])
+            )
+            st_s = sorted(
+                (tuple(sorted(lb.items())), v)
+                for lb, v in st_fams.get(fam + suffix, [])
+            )
+            assert live_s == st_s, f"{fam}{suffix} diverged"
+
+
+# ---- live mini fleet: stitched trace + flight deck ------------------
+
+
+def test_stitched_trace_validator_clean_with_flow_arrows(
+    obs_fleet, tmp_path
+):
+    """The dispatcher stream + both backend streams export as ONE
+    Chrome trace: fleet spans on the dispatcher track, flow arrows
+    (``s``/``t``/``f`` phases keyed by trace_id) binding the chain
+    across tracks, and the whole file validator-clean."""
+    streams = [
+        ("dispatch", _events(obs_fleet["dispatch_stream"])),
+        ("backend0", _events(obs_fleet["configs"][0].telemetry_path)),
+        ("backend1", _events(obs_fleet["configs"][1].telemetry_path)),
+    ]
+    out = str(tmp_path / "fleet_trace.json")
+    tr = trace_mod.write_trace(streams, out)
+    assert trace_mod.validate_trace(out) == []
+    phases = {}
+    for e in tr["traceEvents"]:
+        phases.setdefault(e.get("ph"), []).append(e)
+    # route opens a flow, complete closes it
+    assert phases.get("s"), "no flow-start events (route spans)"
+    assert phases.get("f"), "no flow-end events (complete records)"
+    for e in phases["s"] + phases.get("t", []) + phases["f"]:
+        assert e.get("id"), "flow event without a trace_id binding"
+    fleet_spans = [
+        e for e in tr["traceEvents"] if e.get("cat") == "ptt.fleet"
+    ]
+    assert any(
+        str(e.get("name", "")).startswith("route ")
+        for e in fleet_spans
+    )
+
+    chains = trace_mod.trace_chains(streams)
+    routed = [
+        e["trace_id"] for e in streams[0][1]
+        if e.get("event") == "route"
+        and isinstance(e.get("trace_id"), str)
+    ]
+    for tid in routed:
+        ch = chains[tid]
+        assert ch["routes"] >= 1
+        assert ch["job_events"] >= 1
+        assert any(s.startswith("backend") for s in ch["streams"])
+
+
+def test_top_dispatch_flight_deck_frame(obs_fleet):
+    """One poll fills the fleet model (backend table, rollups,
+    quantiles); the renderer is pure and the second poll grows rate
+    sparklines — the ``top --dispatch --once`` path end to end."""
+    cl = ServiceClient(obs_fleet["fc"].socket_path, timeout=240.0)
+    model = top_mod.FleetTopModel(obs_fleet["fc"].socket_path)
+    frame = top_mod.poll_dispatch_frame(cl, model)
+    assert model.backends
+    for addr in obs_fleet["addrs"]:
+        assert addr in model.backends
+        assert model.backends[addr].get("state") == "up"
+    assert any(
+        fam == "ptt_fleet_job_e2e_seconds"
+        for fam, _p50, _p99, _n in model.quantiles
+    )
+    assert "BACKEND" in frame and "STATE" in frame
+    assert "job e2e" in frame or "P50" in frame
+    frame2 = top_mod.poll_dispatch_frame(cl, model)
+    assert "BACKEND" in frame2
+
+
+# ---- failover: one trace chain across two backends ------------------
+
+
+def test_failover_chain_spans_both_backend_streams(
+    tmp_path, pool, cfg_dir, solo_compaction
+):
+    """The acceptance bar's failed-over job: a queued job's owner
+    dies, the dispatcher resubmits it to the survivor, and the SAME
+    ``trace_id`` chains the dispatcher route, the ``failover``
+    record's ``trace_ids``, and ``job_*`` echoes on BOTH backend
+    streams; the degraded aggregate scrape reports the dead backend
+    in ``ptt_fleet_scrape_errors`` instead of failing."""
+    cfg_path = str(cfg_dir / "small_compaction.cfg")
+    configs = [
+        _config(tmp_path / "b0", slice_s=2.0),
+        _config(tmp_path / "b1", slice_s=2.0),
+    ]
+    daemons = [
+        ServiceDaemon(configs[0], pool=pool),
+        ServiceDaemon(configs[1]),
+    ]
+    for d in daemons:
+        d.start()
+    addrs = [c.socket_path for c in configs]
+    fc = FleetConfig(
+        state_dir=str(tmp_path / "disp"),
+        backends=tuple(addrs),
+        health_interval_s=0.2,
+        fail_after=2,
+        backend_timeout_s=5.0,
+    )
+    disp = FleetDispatcher(fc)
+    disp.start()
+    cl = ServiceClient(fc.socket_path, timeout=240.0, retries=8)
+    try:
+        # pin one backend busy so the probe job QUEUES there (queued
+        # jobs fail over; running jobs are typed lost)
+        js = cl.submit(
+            "compaction", cfg_path, mode="simulate",
+            sim=dict(
+                n_walkers=64, depth=32, segment_len=8,
+                max_steps=1 << 22, seed=7,
+            ),
+            warm=False, submit_id="obs-fo-sim",
+        )
+        _wait(
+            lambda: cl.status(js).get("state") == "running",
+            timeout=120.0, what="sim start",
+        )
+        sub = cl.submit(
+            "compaction", cfg_path, invariants=[], warm=False,
+            submit_id="obs-fo-probe", full=True,
+        )
+        jid, owner, tid = sub["job_id"], sub["backend"], sub["trace_id"]
+        assert cl.status(jid).get("state") == "queued"
+        daemons[addrs.index(owner)].shutdown()
+        _wait(
+            lambda: disp.metrics_snapshot()["failovers"].get(owner),
+            timeout=60.0, what="owner drain",
+        )
+        r = cl.wait(jid, timeout=600.0)
+        assert r.get("state") == jobmod.DONE
+        assert_result_matches_solo(
+            type("R", (), {
+                "result": r.get("result"), "state": r.get("state"),
+                "error": r.get("error"),
+            })(),
+            solo_compaction,
+        )
+
+        # degraded aggregate scrape: the dead owner is reported, the
+        # survivor still rides in labelled
+        text = cl.metrics(aggregate=True)
+        fams, _types = metrics_mod.parse_exposition(text)
+        err_backends = {
+            lb.get("backend")
+            for lb, _v in fams.get("ptt_fleet_scrape_errors", [])
+        }
+        assert owner in err_backends
+    finally:
+        disp.shutdown()
+        for d in daemons:
+            d.shutdown()
+
+    disp_events = _events(os.path.join(fc.state_dir, "dispatch.jsonl"))
+    fo = [
+        e for e in disp_events
+        if e.get("event") == "failover" and e.get("backend") == owner
+    ]
+    assert fo and any(tid in (e.get("trace_ids") or []) for e in fo)
+    assert all(
+        isinstance(e.get("wall_ms"), (int, float)) for e in fo
+    )
+
+    streams = [("dispatch", disp_events)] + [
+        (f"backend{i}", _events(c.telemetry_path))
+        for i, c in enumerate(configs)
+    ]
+    chains = trace_mod.trace_chains(streams)
+    ch = chains[tid]
+    assert ch["failovers"] >= 1
+    both = {f"backend{i}" for i in range(2)}
+    assert both <= set(ch["streams"]), (
+        f"chain {tid} did not span both backends: {ch}"
+    )
+    # and the stitched export of the whole incident validates clean
+    out = str(tmp_path / "failover_trace.json")
+    trace_mod.write_trace(streams, out)
+    assert trace_mod.validate_trace(out) == []

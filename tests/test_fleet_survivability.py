@@ -784,7 +784,9 @@ def test_fleet_chaos_v2_pinned_schedule(
     (``scripts/chaos.py --fleet``): dispatcher kill -9 + --recover
     exactly-once, a partition window reconciled, a flap held to one
     failover by hysteresis, torn replication leaving only verified
-    artifacts, and every stream v14-validator-clean."""
+    artifacts, every stream v15-validator-clean, and (r22) every
+    acked submit's trace_id stitching into a complete chain inside
+    one validator-clean Perfetto export."""
     report = chaos_mod.run_fleet_chaos_v2(
         str(tmp_path / "drill"),
         seed=0,
@@ -799,6 +801,10 @@ def test_fleet_chaos_v2_pinned_schedule(
     assert report["partitions"] >= 1
     assert report["replicated_wire_bytes"] > 0
     assert report["streams_validated"] == 3
+    assert report["trace_chains"] >= 1
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "drill"), "fleet_trace.json")
+    )
 
 
 @pytest.mark.slow
