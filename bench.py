@@ -270,10 +270,10 @@ def load_metrics_records(metrics_path):
 
 
 def artifact_skeleton() -> dict:
-    """Every bench_schema-11 required key, None-filled — the
+    """Every bench_schema-12 required key, None-filled — the
     simulate, matrix, and fleet paths fill what applies and stay
     validator-clean (scripts/check_telemetry_schema.py
-    BENCH_KEYS_V11: keys are REQUIRED, values may be null where the
+    BENCH_KEYS_V12: keys are REQUIRED, values may be null where the
     mode has no measurement)."""
     keys = (
         "metric", "value", "unit", "vs_baseline",
@@ -294,9 +294,14 @@ def artifact_skeleton() -> dict:
         # fleet survivability latencies (r21, bench_schema 11): null
         # on non-fleet runs and on drills that saw no drain/rejoin
         "fleet_failover_ms", "fleet_reconcile_ms",
+        # dense-tile kernel selection (r23, bench_schema 12): the impl
+        # knobs the run executed under + the flush-stage throughput
+        # the tiles ledger gate watches (higher is better)
+        "probe_impl", "expand_impl", "sieve_impl",
+        "probe_lanes_per_sec",
     )
     d = {k: None for k in keys}
-    d["bench_schema"] = 11
+    d["bench_schema"] = 12
     return d
 
 
@@ -568,7 +573,7 @@ def run_matrix(args) -> None:
             f"{args.matrix_ledger}",
             file=sys.stderr,
         )
-    print(json.dumps({"matrix": results, "bench_schema": 11}))
+    print(json.dumps({"matrix": results, "bench_schema": 12}))
 
 
 # -------------------------------------------------------------- fleet
@@ -605,7 +610,7 @@ def run_fleet_bench(args) -> None:
     """``--fleet N``: spin N local ``serve`` backends plus one
     dispatcher in-process (unix sockets under a scratch dir), push a
     replication probe and a mixed batch through the single endpoint,
-    and emit ONE bench_schema-11 JSON line with the fleet keys —
+    and emit ONE bench_schema-12 JSON line with the fleet keys —
     queue throughput (fleet_jobs_per_sec), mean route latency
     (fleet_route_ms), sieve replication economy
     (fleet_replicated_wire_bytes), and the r21 survivability
@@ -795,7 +800,7 @@ def parse_args(argv=None):
         help="fleet bench: spin N local serve backends + one "
         "dispatcher in-process and measure queue throughput / route "
         "latency / replication wire bytes through the single "
-        "endpoint (bench_schema-11 fleet_* keys; docs/fleet.md)",
+        "endpoint (bench_schema-12 fleet_* keys; docs/fleet.md)",
     )
     ap.add_argument(
         "--matrix", action="store_true",
@@ -841,6 +846,27 @@ def parse_args(argv=None):
         "logshift (sort-free prefix-sum + doubling shifts, default) "
         "or sort (the round-4 chunked single-key sorts, kept for "
         "differential timing)",
+    )
+    ap.add_argument(
+        "--probe-impl", dest="probe_impl",
+        choices=["legacy", "tile", "pallas"], default="legacy",
+        help="fpset flush probe kernel (r23, ops/tiles.py): legacy "
+        "(dense rounds in flush_acc, default), tile (membership "
+        "prefilter + chunked insert) or pallas (prefilter as a Pallas "
+        "kernel; interpreted off-TPU).  All exact — same discovery",
+    )
+    ap.add_argument(
+        "--expand-impl", dest="expand_impl",
+        choices=["legacy", "tile", "pallas"], default="legacy",
+        help="successor-sweep structure (r23): legacy (per-window "
+        "scan), tile (flat row sweep + full-matrix key plane) or "
+        "pallas (key plane as a Pallas kernel)",
+    )
+    ap.add_argument(
+        "--sieve-impl", dest="sieve_impl",
+        choices=["legacy", "tile", "pallas"], default="legacy",
+        help="cold-extract kernel on the eviction path (r23): legacy "
+        "(compact+mask+sort), tile (mask-in-place + sort) or pallas",
     )
     ap.add_argument(
         "--fuse", choices=["level", "stage"], default="level",
@@ -1019,6 +1045,11 @@ def main(argv=None):
             user_set.add("fuse_group")
         if args.compact != "logshift":
             user_set.add("compact_impl")
+        # dense-tile kernel knobs (r23): an explicit impl flag wins
+        # over the tuned profile, mirroring --compact
+        for flag in ("probe_impl", "expand_impl", "sieve_impl"):
+            if getattr(args, flag) != "legacy":
+                user_set.add(flag)
         for k, v in sorted(pk.items()):
             if k == "adapt" or k in user_set:
                 continue
@@ -1050,6 +1081,9 @@ def main(argv=None):
         metrics_path=metrics_path,
         visited_impl=args.visited,
         compact_impl=kw.pop("compact_impl", args.compact),
+        probe_impl=kw.pop("probe_impl", args.probe_impl),
+        expand_impl=kw.pop("expand_impl", args.expand_impl),
+        sieve_impl=kw.pop("sieve_impl", args.sieve_impl),
         fuse=args.fuse,
         fuse_group=kw.pop("fuse_group", args.fuse_group),
         hbm_budget=args.hbm_budget,
@@ -1224,8 +1258,12 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # schema 11 (r21) adds the fleet survivability
                 # latencies (fleet_failover_ms, fleet_reconcile_ms —
                 # null on solo runs and on drills without a
-                # drain/rejoin)
-                "bench_schema": 11,
+                # drain/rejoin); schema 12 (r23) adds the dense-tile
+                # kernel selection (probe_impl, expand_impl,
+                # sieve_impl — the impls that actually ran) and
+                # probe_lanes_per_sec, the flush-stage throughput the
+                # tiles ledger gate watches
+                "bench_schema": 12,
                 "mode": "check",
                 "walks_per_sec": None,
                 "steps_per_state": None,
@@ -1296,6 +1334,19 @@ def _emit(args, ck, c, r, compile_s, metrics_path):
                 # stream-compaction impl on the append hot path (r10:
                 # logshift default; sort kept for differential timing)
                 "compact_impl": args.compact,
+                # dense-tile kernel selection (r23, bench_schema 12):
+                # ck.*, not args.*: a tuned profile may have picked
+                # the impl, and the artifact must report what ran.
+                # probe_lanes_per_sec is the flush-stage throughput
+                # the tiles ledger gate watches (higher is better)
+                "probe_impl": ck.probe_impl,
+                "expand_impl": ck.expand_impl,
+                "sieve_impl": ck.sieve_impl,
+                "probe_lanes_per_sec": (
+                    round(stat("work_probe_lanes") / r.wall_s, 1)
+                    if stat("work_probe_lanes") and r.wall_s > 0
+                    else None
+                ),
                 # level fusion (r13): the megakernel's dispatch
                 # economy — total dispatches per BFS level, fused
                 # dispatches, and levels the ramp batched.  ck.fuse,

@@ -159,6 +159,9 @@ def _check_compiled_spec(args, module, spec_path, tlc_cfg, invariants):
         metrics_path=args.metrics,
         visited_impl=args.visited,
         compact_impl=_tunable(args, "compact", args.compact),
+        probe_impl=_tunable(args, "probe_impl", args.probe_impl),
+        expand_impl=_tunable(args, "expand_impl", args.expand_impl),
+        sieve_impl=_tunable(args, "sieve_impl", args.sieve_impl),
         fuse=args.fuse,
         fuse_group=args.fuse_group,
         hbm_budget=args.hbm_budget,
@@ -399,7 +402,14 @@ def _check_properties(args, model, properties, rc):
 # silently change every untuned check's geometry — `cli check` always
 # passes sub_batch explicitly, and sub_batch stays tunable through
 # bench/tune/serve, whose defaults ARE the engine's (docs/tuning.md).
-_TUNABLE_DEFAULTS = {"compact": "logshift"}
+_TUNABLE_DEFAULTS = {
+    "compact": "logshift",
+    # dense-tile kernel knobs (r23, ops/tiles.py): all exact
+    # reformulations, so a tuned profile may pick any of them
+    "probe_impl": "legacy",
+    "expand_impl": "legacy",
+    "sieve_impl": "legacy",
+}
 
 
 def _tunable(args, name, value):
@@ -573,6 +583,9 @@ def _dispatch_engines(args, model, constants, invariants, tlc_cfg, t0):
             metrics_path=args.metrics,
             visited_impl=args.visited,
             compact_impl=_tunable(args, "compact", args.compact),
+            probe_impl=_tunable(args, "probe_impl", args.probe_impl),
+            expand_impl=_tunable(args, "expand_impl", args.expand_impl),
+            sieve_impl=_tunable(args, "sieve_impl", args.sieve_impl),
             fuse=args.fuse,
             fuse_group=args.fuse_group,
             hbm_budget=args.hbm_budget,
@@ -2196,6 +2209,36 @@ def main(argv=None):
         "append/sweep hot paths: 'logshift' (sort-free prefix-sum + "
         "doubling shifts, default) or 'sort' (the legacy chunked "
         "single-key sorts, kept for differential timing)",
+    )
+    pc.add_argument(
+        "-probe-impl",
+        dest="probe_impl",
+        choices=["legacy", "tile", "pallas"],
+        default="legacy",
+        help="fpset flush probe kernel (round 23, ops/tiles.py): "
+        "'legacy' (dense probe rounds inside flush_acc, default), "
+        "'tile' (lane-tiled membership prefilter + chunked insert) or "
+        "'pallas' (the prefilter as a Pallas kernel; interpreted off-"
+        "TPU).  All three are exact — discovery order is identical",
+    )
+    pc.add_argument(
+        "-expand-impl",
+        dest="expand_impl",
+        choices=["legacy", "tile", "pallas"],
+        default="legacy",
+        help="successor-sweep structure (round 23): 'legacy' (per-"
+        "window scan), 'tile' (flat row sweep + full-matrix key "
+        "plane) or 'pallas' (tile with the key plane as a Pallas "
+        "kernel)",
+    )
+    pc.add_argument(
+        "-sieve-impl",
+        dest="sieve_impl",
+        choices=["legacy", "tile", "pallas"],
+        default="legacy",
+        help="cold-extract kernel on the tiered-store eviction path "
+        "(round 23): 'legacy' (compact+mask+sort), 'tile' (mask-in-"
+        "place + sort) or 'pallas' (the mask as a Pallas kernel)",
     )
     pc.add_argument(
         "-fuse",

@@ -387,6 +387,11 @@ class Checker:
             # v15: distributed-trace identity (fleet dispatcher ->
             # backend -> engine; None outside the daemon)
             trace_id=getattr(self, "trace_id", None),
+            # v16: dense-tile kernel selection — null here; only
+            # device_bfs carries the ops/tiles.py impl knobs
+            probe_impl=None,
+            expand_impl=None,
+            sieve_impl=None,
             # v11: workload class (exhaustive BFS)
             mode="check",
             wall_unix=round(time.time(), 3),

@@ -88,6 +88,7 @@ from pulsar_tlaplus_tpu.utils import ckpt, device, faults, recovery
 from pulsar_tlaplus_tpu.utils.aot_cache import ajit
 from pulsar_tlaplus_tpu.ops import compact as compact_ops
 from pulsar_tlaplus_tpu.ops import dedup, fpset
+from pulsar_tlaplus_tpu.ops import tiles as tile_ops
 from pulsar_tlaplus_tpu.ops.dedup import SENTINEL, KeySpec
 from pulsar_tlaplus_tpu.ref import pyeval
 
@@ -155,6 +156,9 @@ class DeviceChecker:
         row_cap_states: Optional[int] = None,
         visited_impl: str = "fpset",
         compact_impl: Optional[str] = None,
+        probe_impl: Optional[str] = None,
+        expand_impl: Optional[str] = None,
+        sieve_impl: Optional[str] = None,
         fuse: str = "level",
         fuse_group: Optional[int] = None,
         fpset_dense_rounds: Optional[int] = None,
@@ -231,6 +235,9 @@ class DeviceChecker:
                     "fpset_dense_rounds": fpset_dense_rounds,
                     "fpset_stages": fpset_stages,
                     "compact_impl": compact_impl,
+                    "probe_impl": probe_impl,
+                    "expand_impl": expand_impl,
+                    "sieve_impl": sieve_impl,
                     "hbm_headroom": hbm_headroom,
                     "spill_compress": spill_compress,
                     "miss_batch": miss_batch,
@@ -251,6 +258,13 @@ class DeviceChecker:
         compact_impl = (
             compact_impl or _pk.get("compact_impl") or "logshift"
         )
+        # dense-tile kernel knobs (round 23, ops/tiles.py): same
+        # explicit > profile > default resolution; the tile/pallas
+        # variants are exact reformulations (discovery order pinned
+        # state-for-state), so the tuner may swap them freely per shape
+        probe_impl = probe_impl or _pk.get("probe_impl")
+        expand_impl = expand_impl or _pk.get("expand_impl")
+        sieve_impl = sieve_impl or _pk.get("sieve_impl")
         fuse_group = (
             fuse_group if fuse_group is not None
             else _pk.get("fuse_group")
@@ -324,6 +338,21 @@ class DeviceChecker:
         # the round-6 -visited sort pattern).  The fpset's staged
         # pending-compaction uses the same impl inside the flush.
         self.compact_impl = compact_ops.validate_impl(compact_impl)
+        # Dense-tile kernel layer (round 23 tentpole, ops/tiles.py):
+        # per-kernel impl selection — "legacy" keeps the existing
+        # formulations, "tile" the blocked pure-XLA ones, "pallas" the
+        # explicit Pallas kernels (interpret-mode on CPU).  All three
+        # are pinned state-for-state identical; the knobs exist so
+        # `cli.py tune` can arbitrate the winner per shape.
+        self.probe_impl = tile_ops.validate_impl(
+            "probe_impl", probe_impl
+        )
+        self.expand_impl = tile_ops.validate_impl(
+            "expand_impl", expand_impl
+        )
+        self.sieve_impl = tile_ops.validate_impl(
+            "sieve_impl", sieve_impl
+        )
         # Level fusion (round 13 tentpole): "level" (default) runs each
         # BFS level as ONE fused megakernel dispatch (ramp levels batch
         # several levels per dispatch — see the module docstring);
@@ -754,10 +783,52 @@ class DeviceChecker:
         rows into the accumulator at ``acc_off``.  ``f_off`` is the
         window's first row index within the current level (for
         liveness masking and deadlock gids).  Returns
-        ``(ak', arows', dead_gid')``."""
+        ``(ak', arows', dead_gid')``.
+
+        ``expand_impl`` (round 23) selects the sweep's compiled
+        structure: ``legacy`` is the ``lax.scan`` over ``G/Fi`` chunks
+        below; ``tile`` / ``pallas`` evaluate the whole ``(G, A)``
+        successor matrix as one batched tile op and form the key plane
+        on the full ``(G*A, W)`` matrix via ``ops.tiles.key_plane``
+        (``pallas`` runs the key mixing as an explicit row-tiled
+        kernel).  Per-lane math is identical elementwise and the
+        deadlock min-of-mins equals the scan's, so gids, rows, and
+        logs are bit-identical under every impl."""
         m, layout = self.model, self.layout
         Fi, A, W, G = self.Fi, self.A, self.W, self.G
         keyspec = self.keys
+
+        if self.expand_impl != "legacy":
+            rows = window.reshape(G, W)
+            pos = f_off + jnp.arange(G, dtype=jnp.int32)
+            live = pos < n_live
+            states = jax.vmap(layout.unpack)(rows)
+            succ, valid = jax.vmap(m.successors)(states)  # [G, A]
+            valid = valid & live[:, None]
+            packed = jax.vmap(jax.vmap(layout.pack))(succ)
+            nc = G * A
+            packedf = packed.reshape(nc, W)
+            vflat = valid.reshape(nc)
+            kcols = tile_ops.key_plane(
+                keyspec, packedf, vflat, impl=self.expand_impl
+            )
+            if self.check_deadlock:
+                stut = jax.vmap(m.stutter_enabled)(states)
+                dead_rows = live & ~jnp.any(valid, axis=1) & ~stut
+                didx = jnp.min(jnp.where(dead_rows, pos, BIG))
+            else:
+                didx = BIG
+            dead = jnp.minimum(
+                dead_gid, jnp.where(didx < BIG, gid_base + didx, BIG)
+            )
+            ak = tuple(
+                lax.dynamic_update_slice(akc, kc, (acc_off,))
+                for akc, kc in zip(ak, kcols)
+            )
+            arows = lax.dynamic_update_slice(
+                arows, packedf.T, (0, acc_off)
+            )
+            return ak, arows, dead
 
         def chunk(i):
             rows = lax.dynamic_slice(
@@ -807,7 +878,7 @@ class DeviceChecker:
         f_off, n_live, dead_gid, gid_base, acc_off) -> (ak', arows',
         dead_gid') — the stage-chain dispatch over ``_expand_body``;
         capacity-independent apart from the fixed ACAP."""
-        key = ("expand",)
+        key = ("expand", self.expand_impl)
         if key in self._jits:
             return self._jits[key]
 
@@ -940,7 +1011,7 @@ class DeviceChecker:
         cannot continue honestly."""
         key = (
             "fpflush", self.TCAP, self.compact_impl, self.fps_dense,
-            self.fps_stages,
+            self.fps_stages, self.probe_impl,
         )
         if key in self._jits:
             return self._jits[key]
@@ -956,6 +1027,7 @@ class DeviceChecker:
                 tc, ak, n_acc, fpm,
                 dense_rounds=self.fps_dense, stages=self.fps_stages,
                 compact_impl=self.compact_impl,
+                probe_impl=self.probe_impl,
             )
             return (*tc2, n_new, flag, fpm)
 
@@ -1210,7 +1282,7 @@ class DeviceChecker:
         key = (
             "fused", self.TCAP, self.LCAP, self.PCAP,
             self.compact_impl, self.fps_dense, self.fps_stages,
-            self.RMAX,
+            self.RMAX, self.probe_impl, self.expand_impl,
         )
         if key in self._jits:
             return self._jits[key]
@@ -1285,6 +1357,7 @@ class DeviceChecker:
                     vk, ak, jnp.int32(ACAP), fpm,
                     dense_rounds=self.fps_dense,
                     stages=self.fps_stages, compact_impl=impl,
+                    probe_impl=self.probe_impl,
                 )
                 crows, idx = compact_ops.compact_rows(
                     arows, flag, impl=impl
@@ -1454,7 +1527,10 @@ class DeviceChecker:
         cutoff, sorted for the host's delta codec.  The holed table
         must be rehashed (:meth:`_rehash_same_jit`) before it serves
         lookups again."""
-        key = ("spill_evict", self.TCAP, self.compact_impl)
+        key = (
+            "spill_evict", self.TCAP, self.compact_impl,
+            self.sieve_impl,
+        )
         if key in self._jits:
             return self._jits[key]
         K = self.K
@@ -1462,7 +1538,8 @@ class DeviceChecker:
 
         def step(*args):
             holed, gen, ev, n = store_sieve.extract_cold(
-                args[:K], args[K], args[K + 1], compact_impl=impl
+                args[:K], args[K], args[K + 1], compact_impl=impl,
+                sieve_impl=self.sieve_impl,
             )
             return (*holed, gen, *ev, n)
 
@@ -2178,6 +2255,7 @@ class DeviceChecker:
                 key = (
                     "fused", tcap, lcap, pcap, self.compact_impl,
                     self.fps_dense, self.fps_stages, self.RMAX,
+                    self.probe_impl, self.expand_impl,
                 )
                 if key in self._jits:
                     continue  # the entry triple compiled in warmup()
@@ -2207,7 +2285,7 @@ class DeviceChecker:
                 )
             if (
                 "fpflush", self.TCAP, self.compact_impl,
-                self.fps_dense, self.fps_stages,
+                self.fps_dense, self.fps_stages, self.probe_impl,
             ) not in self._jits:
                 ak = tuple(
                     jnp.full((self.ACAP,), SENTINEL, jnp.uint32)
@@ -2619,6 +2697,11 @@ class DeviceChecker:
             device=dev,
             visited_impl=self.visited_impl,
             compact_impl=self.compact_impl,
+            # v16: dense-tile kernel selection (r23, ops/tiles.py) —
+            # always present so the ledger can split impl trajectories
+            probe_impl=self.probe_impl,
+            expand_impl=self.expand_impl,
+            sieve_impl=self.sieve_impl,
             fuse=self.fuse,
             fuse_group=self.RMAX,
             config_sig=self._config_sig(),
@@ -4695,6 +4778,12 @@ class DeviceChecker:
         self.last_stats.update(
             fuse=self.fuse,
             compact_impl=self.compact_impl,
+            # dense-tile kernel selection (r23): ride the stats dict so
+            # bench artifacts and the ledger see the impls without a
+            # header join
+            probe_impl=self.probe_impl,
+            expand_impl=self.expand_impl,
+            sieve_impl=self.sieve_impl,
             hbm_recovered=self._hbm_recovered,
             ckpt_frames=self._ckpt_frames,
             ckpt_bytes=self._ckpt_bytes,

@@ -82,6 +82,12 @@ GATE_DIRECTIONS: Dict[str, str] = {
     # — both lower-better service-tier latencies
     "fleet_failover_ms": "lower",
     "fleet_reconcile_ms": "lower",
+    # dense-tile kernels (r23, bench_schema 12): flush-stage probe
+    # throughput — the head-to-head signal for the impl knobs.  The
+    # impls are NOT part of config_key (every impl is an exact
+    # reformulation, same comparability class), which is exactly what
+    # lets a tile-impl record gate against the legacy baseline.
+    "probe_lanes_per_sec": "higher",
 }
 # the machine-independent subset — the tier-1 gate's default
 DETERMINISTIC_GATE_KEYS = (
@@ -103,6 +109,12 @@ SIM_GATE_KEYS = ("steps_per_state",)
 # fixed workload; the latency keys ride along so a committed
 # baseline documents the survivability envelope too.
 FLEET_GATE_KEYS = ("fleet_replicated_wire_bytes",)
+# the dense-tile kernel gate subset (r23): the impl knobs may not
+# change the state-determined economy (tests/test_tiles.py gates a
+# tile-impl record against the committed legacy mini baseline on
+# exactly these keys; probe_lanes_per_sec is wall-clock and gates
+# real-chip trajectories only)
+TILES_GATE_KEYS = DETERMINISTIC_GATE_KEYS
 
 
 def _digest(values: dict) -> str:
@@ -172,6 +184,16 @@ def _derive(values: dict) -> dict:
             and isinstance(comp, (int, float))
         ):
             values["spill_bytes_per_state"] = round(comp / n, 2)
+    # flush-stage probe throughput (r23): derived for pre-schema-12
+    # artifacts and mini bench records that carry the raw inputs
+    lanes = values.get("work_probe_lanes")
+    wall = values.get("wall_s")
+    if (
+        values.get("probe_lanes_per_sec") is None
+        and isinstance(lanes, (int, float)) and lanes
+        and isinstance(wall, (int, float)) and wall
+    ):
+        values["probe_lanes_per_sec"] = round(lanes / wall, 1)
     return values
 
 

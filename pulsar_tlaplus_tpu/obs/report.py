@@ -235,6 +235,11 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
         out.setdefault("hbm_recovered", len(recov))
     if "compact_impl" in stats:
         out["compact_impl"] = stats["compact_impl"]
+    # dense-tile kernel selection (r23, bench_schema 12): which impl
+    # served each kernel this run
+    for k in ("probe_impl", "expand_impl", "sieve_impl"):
+        if k in stats:
+            out[k] = stats[k]
     # level fusion (r13): the dispatch-economy keys — megakernel
     # dispatches, levels it closed, and the run's dispatches/level
     for k in ("fuse", "dispatches_per_level", "stage_fused_n",
@@ -268,6 +273,9 @@ def bench_keys(events: List[dict]) -> Dict[str, object]:
         out["visited_impl"] = hd.get("visited_impl")
         if "compact_impl" not in out and hd.get("compact_impl"):
             out["compact_impl"] = hd.get("compact_impl")
+        for k in ("probe_impl", "expand_impl", "sieve_impl"):
+            if k not in out and hd.get(k):
+                out[k] = hd.get(k)
         if "fuse" not in out and hd.get("fuse"):
             out["fuse"] = hd.get("fuse")
         out["run_id"] = hd.get("run_id")

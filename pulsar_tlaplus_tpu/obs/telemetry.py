@@ -168,10 +168,16 @@ from typing import Callable, Dict, Optional, Tuple, Union
 # or overflowing a submit), and ``persist_fail`` (a fleet_jobs.json
 # persist that stayed failed after the retry — the counter was
 # previously invisible to stream replay).
+# v16 (round 23, the dense-tile kernel layer): every run header
+# carries the per-kernel impl selection — ``probe_impl`` /
+# ``expand_impl`` / ``sieve_impl`` (legacy|tile|pallas, ops/tiles.py;
+# null on engines without the knobs) — REQUIRED at v16 like the other
+# header attribution fields so impl trajectories always split in the
+# ledger without a stats join.
 # Validators accept <= SCHEMA_VERSION and hold a record only to the
 # fields its OWN version requires (FIELD_SINCE) — pre-r10 streams stay
 # valid.
-SCHEMA_VERSION = 15
+SCHEMA_VERSION = 16
 
 # Authoritative event table: event name -> required fields beyond the
 # base envelope.  Unknown events are legal (forward compatibility) but
@@ -297,6 +303,12 @@ FIELD_SINCE: Dict[Tuple[str, str], int] = {
     ("job_result", "trace_id"): 15,
     ("job_cancel", "trace_id"): 15,
     ("run_header", "trace_id"): 15,
+    # v16 (round 23): the dense-tile kernel selection on every run
+    # header (null on engines without the knobs) — gated so every
+    # committed v15-and-older stream stays validator-clean.
+    ("run_header", "probe_impl"): 16,
+    ("run_header", "expand_impl"): 16,
+    ("run_header", "sieve_impl"): 16,
     ("admission", "action"): 10,
     ("admission", "tenant"): 10,
     ("auth", "action"): 10,
@@ -316,6 +328,7 @@ EVENTS: Dict[str, Tuple[str, ...]] = {
     "run_header": (
         "engine", "visited_impl", "config_sig", "profile_sig",
         "hbm_budget", "tenant", "mode", "warm", "trace_id",
+        "probe_impl", "expand_impl", "sieve_impl",
     ),
     "result": ("distinct_states", "diameter", "wall_s", "truncated"),
     # progress

@@ -511,6 +511,7 @@ def flush_acc(
     dense_rounds: Optional[int] = None,
     stages=None,
     compact_impl: str = "logshift",
+    probe_impl: str = "legacy",
 ):
     """One accumulator flush as a traced sub-function (round 13): mask
     the live prefix, probe-or-insert, count the new states, and ride
@@ -524,7 +525,21 @@ def flush_acc(
     ``n_acc`` (a stale tail from a previous fill) and all-SENTINEL
     lanes (masked expand output) are invalid; min-lane-wins keeps the
     sort-merge flush's discovery order.
+
+    ``probe_impl`` selects the probe kernel (round 23): ``legacy`` is
+    the staged loop below; ``tile`` / ``pallas`` route to the blocked
+    membership-prefilter formulations in ``ops/tiles.py``, which are
+    pinned bit-identical on ``is_new`` (discovery order depends only
+    on pre-flush membership + min-lane-wins, never slot placement).
     """
+    if probe_impl != "legacy":
+        from pulsar_tlaplus_tpu.ops import tiles  # lazy: tiles imports us
+
+        return tiles.flush_acc_tiles(
+            tcols, kcols, n_acc, fpm,
+            dense_rounds=dense_rounds, stages=stages,
+            compact_impl=compact_impl, probe_impl=probe_impl,
+        )
     nq = kcols[0].shape[0]
     lanei = jnp.arange(nq, dtype=jnp.int32)
     amask = lanei < n_acc

@@ -62,6 +62,7 @@ def extract_cold(
     gen: jax.Array,
     cutoff,
     compact_impl: str = "logshift",
+    sieve_impl: str = "legacy",
 ):
     """Select slots with ``1 <= gen <= cutoff``, pack their keys
     densely, sort them, and clear the slots.
@@ -70,7 +71,18 @@ def extract_cold(
     — ``ev_cols_sorted`` are full-table-width columns whose first
     ``n_evicted`` lanes hold the evicted keys in unsigned
     lexicographic column order (SENTINEL padding sorts last).  The
-    holed table MUST be rehashed before serving lookups again."""
+    holed table MUST be rehashed before serving lookups again.
+
+    ``sieve_impl`` selects the extract kernel (round 23): ``legacy``
+    is the compact+mask+sort below; ``tile`` / ``pallas`` route to
+    ``ops/tiles.py``'s mask-in-place formulation (the sort sees the
+    same multiset, so outputs are array-identical)."""
+    if sieve_impl != "legacy":
+        from pulsar_tlaplus_tpu.ops import tiles  # lazy: avoids cycle
+
+        return tiles.extract_cold_tiles(
+            tcols, gen, cutoff, sieve_impl=sieve_impl
+        )
     cap1 = tcols[0].shape[0]
     occ = _occupied_full(tcols)
     cold = occ & (gen >= 1) & (gen <= jnp.int32(cutoff))

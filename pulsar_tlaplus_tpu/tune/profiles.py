@@ -60,6 +60,9 @@ _POSITIVE_INT_KNOBS = (
     "n_walkers", "segment_len",
 )
 _COMPACT_IMPLS = ("logshift", "sort")
+# dense-tile kernel knobs (r23, ops/tiles.py) share one impl enum
+_TILE_IMPL_KNOBS = ("probe_impl", "expand_impl", "sieve_impl")
+_TILE_IMPLS = ("legacy", "tile", "pallas")
 
 
 def profiles_dir() -> str:
@@ -238,6 +241,11 @@ def validate(profile, path: str = "<profile>") -> List[str]:
             errs.append(
                 f"{path}: knob compact_impl must be one of "
                 f"{_COMPACT_IMPLS} (got {val!r})"
+            )
+        elif k in _TILE_IMPL_KNOBS and val not in _TILE_IMPLS:
+            errs.append(
+                f"{path}: knob {k!r} must be one of "
+                f"{_TILE_IMPLS} (got {val!r})"
             )
         elif k == "adapt" and not isinstance(val, bool):
             errs.append(

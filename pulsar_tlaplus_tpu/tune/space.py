@@ -19,6 +19,13 @@ order; pinned by the differential tests in tests/test_tune.py):
 - ``fpset_dense_rounds``  full-width probe rounds before the staged
                       pending-compaction shrinks the batch
 - ``compact_impl``    stream-compaction materialization (logshift|sort)
+- ``probe_impl``      fpset flush probe kernel (legacy|tile|pallas —
+                      round 23, ops/tiles.py; exact reformulations,
+                      discovery order pinned identical)
+- ``expand_impl``     successor-sweep structure (legacy|tile|pallas)
+- ``sieve_impl``      cold-extract kernel (legacy|tile|pallas;
+                      searched only for budgeted workloads, with the
+                      other spill knobs)
 
 Tiered-store knobs (round 16, searched only for budgeted workloads —
 ``candidates(spill=True)``; they are no-ops untiered and would only
@@ -55,6 +62,20 @@ DEVICE_KNOBS: Tuple[Knob, ...] = (
     Knob("group", (None, 2, 8), "dispatch group-ahead"),
     Knob("fuse_group", (None, 1, 4, 16), "ramp levels per dispatch"),
     Knob("fpset_dense_rounds", (None, 2, 8), "dense probe rounds"),
+    # dense-tile kernel selection (round 23, ops/tiles.py).  Unlike
+    # compact_impl below, these ARE searched: every impl is an exact
+    # reformulation pinned state-for-state identical (same ledger
+    # comparability class), so a tuned tile profile gates cleanly
+    # against the legacy baseline.  predict.py prices each impl's
+    # probe/expand lanes at calibrated (or default-ratio) unit costs.
+    Knob(
+        "probe_impl", (None, "tile", "pallas"),
+        "fpset flush probe kernel (None = legacy)",
+    ),
+    Knob(
+        "expand_impl", (None, "tile", "pallas"),
+        "successor-sweep structure (None = legacy)",
+    ),
     # compact_impl is deliberately NOT searched: the ledger's config
     # key folds it in (a sort-impl run is a different comparability
     # class, kept for differential timing), so a profile that tuned
@@ -76,6 +97,12 @@ SPILL_KNOBS: Tuple[Knob, ...] = (
     Knob(
         "miss_batch", (None, 1 << 14, 1 << 16),
         "sieved keys per cold-lookup batch",
+    ),
+    # the sieve tile kernel (round 23) only runs on the eviction path,
+    # so it is searched with the other budgeted-workload knobs
+    Knob(
+        "sieve_impl", (None, "tile", "pallas"),
+        "cold-extract kernel (None = legacy)",
     ),
 )
 
@@ -127,6 +154,7 @@ PROFILE_KNOBS: Dict[str, Tuple[str, ...]] = {
         "sub_batch", "flush_factor", "group", "fuse_group",
         "fpset_dense_rounds", "fpset_stages", "compact_impl", "adapt",
         "hbm_headroom", "spill_compress", "miss_batch",
+        "probe_impl", "expand_impl", "sieve_impl",
     ),
     "liveness": ("sweep_group", "compact_impl", "adapt"),
     "sim": ("n_walkers", "segment_len"),

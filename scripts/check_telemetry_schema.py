@@ -107,7 +107,14 @@ and the new ``complete``/``relay``/``hold``/``shed``/``persist_fail``
 events close the job, time the watch-relay legs, and make the
 dispatcher's hold/shed/persist counters stream-derivable
 (``persist_fail`` carries the CUMULATIVE count) — all
-FIELD_SINCE-gated.  ``--metrics`` validates Prometheus exposition
+FIELD_SINCE-gated.  r23: v16 run headers carry the dense-tile kernel
+selection (``probe_impl``/``expand_impl``/``sieve_impl`` — the
+ops/tiles.py impls the run executed under; null on engines without
+the knobs), and bench_schema >= 12 artifacts additionally require
+those three keys plus ``probe_lanes_per_sec`` (the flush-stage
+throughput the tiles ledger gate watches) — all FIELD_SINCE-gated so
+committed v15-and-older streams stay clean.  ``--metrics`` validates
+Prometheus exposition
 text files (``cli.py metrics`` output) instead: TYPE-histogram
 families must carry cumulative monotone buckets ending at ``+Inf``,
 a ``_count`` equal to the ``+Inf`` bucket, and a ``_sum`` inside the
@@ -186,6 +193,14 @@ BENCH_KEYS_V10 = BENCH_KEYS_V9 + (
 # themselves are required)
 BENCH_KEYS_V11 = BENCH_KEYS_V10 + (
     "fleet_failover_ms", "fleet_reconcile_ms",
+)
+# v12 (r23): the dense-tile kernel selection — the probe/expand/sieve
+# impls the run actually executed under (null on engines without the
+# ops/tiles.py knobs) and the flush-stage probe throughput the tiles
+# ledger gate watches (null when no probe lanes were counted; the
+# keys themselves are required)
+BENCH_KEYS_V12 = BENCH_KEYS_V11 + (
+    "probe_impl", "expand_impl", "sieve_impl", "probe_lanes_per_sec",
 )
 
 
@@ -416,7 +431,9 @@ def validate_bench_artifact(path_or_dict, path: str = "") -> List[str]:
     if not isinstance(schema, int) or schema < 2:
         errors.append(f"{label}: bad bench_schema {schema!r}")
         return errors
-    if schema >= 11:
+    if schema >= 12:
+        required = BENCH_KEYS_V12
+    elif schema >= 11:
         required = BENCH_KEYS_V11
     elif schema >= 10:
         required = BENCH_KEYS_V10

@@ -28,6 +28,29 @@ needs_shard_map = pytest.mark.skipif(
 
 
 @functools.lru_cache(maxsize=1)
+def _pallas_lowers_natively() -> bool:
+    try:
+        from pulsar_tlaplus_tpu.ops import tiles
+
+        return tiles.pallas_lowers_natively()
+    except Exception:  # noqa: BLE001 — any failure mode means "skip"
+        return False
+
+
+# The r23 Pallas tile kernels compile natively only on a TPU backend;
+# everywhere else ops/tiles.py runs them under interpret=True, which
+# the always-on parity tests already exercise.  Same regime as
+# needs_shard_map: tests pinning NATIVE lowering behavior (mosaic
+# compilation, on-chip timing) SKIP on the CPU-mesh container and run
+# on the real host.
+needs_pallas_tpu = pytest.mark.skipif(
+    not _pallas_lowers_natively(),
+    reason="native Pallas lowering needs a TPU backend (interpret-"
+    "mode parity tests still run here)",
+)
+
+
+@functools.lru_cache(maxsize=1)
 def _native_baseline_runnable() -> bool:
     """True when the COMMITTED native baseline binary actually RUNS
     here.  The binary was built on the real host; a container with an

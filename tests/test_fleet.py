@@ -18,7 +18,7 @@ The acceptance bar (docs/fleet.md):
   fleet-era multi-writer mix: saves, peer-push installs, LRU cap).
 
 The slow-marked load test runs a 3-backend mixed-spec batch and emits
-a bench_schema-11 fleet artifact the validator and ledger accept.
+a current-rev bench artifact the validator and ledger accept.
 """
 
 import json
@@ -350,7 +350,7 @@ def test_fleet_ledger_gate_pinned_baseline(
         tmp_path / "gate", pool,
         str(cfg_dir / "small_compaction.cfg"),
     )
-    assert art["bench_schema"] == 11
+    assert art["bench_schema"] == 12  # current rev (r23 bump)
     errs = checker_mod.validate_bench_artifact(art, "fleet-gate")
     assert errs == []
     apath = str(tmp_path / "fleet_gate.json")
@@ -523,7 +523,7 @@ def test_fleet_three_backend_load(
     """Load shape: 3 backends, a mixed batch of compaction +
     bookkeeper jobs through one dispatcher, every result solo-exact;
     the measured queue throughput / route latency / replication bytes
-    are emitted as a bench_schema-11 artifact the validator accepts
+    are emitted as a current-rev bench artifact the validator accepts
     and the ledger ingests."""
     configs = [
         _config(tmp_path / f"b{i}", slice_s=0.3) for i in range(3)
@@ -574,7 +574,7 @@ def test_fleet_three_backend_load(
         for d in daemons:
             d.shutdown()
 
-    # BENCH-shaped artifact at the fleet rev (bench_schema 11)
+    # BENCH-shaped artifact at the current rev
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -612,7 +612,7 @@ def test_fleet_three_backend_load(
             if snap.get("reconcile_n") else None
         ),
     )
-    assert d["bench_schema"] == 11
+    assert d["bench_schema"] == 12  # current rev (r23 bump)
     errs = checker_mod.validate_bench_artifact(d, "fleet")
     assert errs == []
 
@@ -624,6 +624,6 @@ def test_fleet_three_backend_load(
     with open(art, "w") as f:
         f.write(json.dumps(d))
     rec = ledgermod.record_from_file(art)
-    assert rec["bench_schema"] == 11
+    assert rec["bench_schema"] == 12
     assert ledgermod.append(path, [rec]) == 1
     assert ledgermod.validate_ledger(path) == []
